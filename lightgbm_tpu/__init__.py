@@ -1,0 +1,57 @@
+"""LightGBM-TPU: a TPU-native gradient boosting framework.
+
+A brand-new JAX/XLA/Pallas implementation of the LightGBM feature set
+(histogram-based leaf-wise GBDT with GOSS/EFB, the full objective/metric zoo,
+DART/RF boosting, distributed training over a TPU mesh) — designed TPU-first,
+not ported. See SURVEY.md at the repo root for the blueprint.
+
+Public API mirrors the reference python-package:
+
+    import lightgbm_tpu as lgb
+    train_set = lgb.Dataset(X, label=y)
+    booster = lgb.train({"objective": "binary"}, train_set, num_boost_round=100)
+    preds = booster.predict(X_test)
+"""
+
+__version__ = "0.1.0"
+
+from .config import Config
+from .utils.log import Log, LightGBMError
+
+try:  # full API surface; modules come online as the build proceeds
+    from .basic import Booster, Dataset, register_logger
+    from .engine import train, cv, CVBooster
+    from .callback import (
+        early_stopping,
+        log_evaluation,
+        print_evaluation,
+        record_evaluation,
+        reset_parameter,
+        EarlyStopException,
+    )
+except ImportError:  # pragma: no cover — bootstrap only
+    pass
+
+try:  # sklearn wrappers are optional (sklearn itself may be absent)
+    from .sklearn import LGBMModel, LGBMClassifier, LGBMRegressor, LGBMRanker
+    _SKLEARN = ["LGBMModel", "LGBMClassifier", "LGBMRegressor", "LGBMRanker"]
+except ImportError:  # pragma: no cover
+    _SKLEARN = []
+
+__all__ = [
+    "Config",
+    "Log",
+    "LightGBMError",
+    "Dataset",
+    "Booster",
+    "register_logger",
+    "train",
+    "cv",
+    "CVBooster",
+    "early_stopping",
+    "log_evaluation",
+    "print_evaluation",
+    "record_evaluation",
+    "reset_parameter",
+    "EarlyStopException",
+] + _SKLEARN

@@ -1,0 +1,441 @@
+"""Single-source-of-truth parameter registry.
+
+TPU-native equivalent of the reference's ``struct Config`` + generated alias
+table (reference: include/LightGBM/config.h:34, src/io/config_auto.cpp,
+helpers/parameter_generator.py). One dataclass holds every typed parameter;
+``ALIASES`` maps every accepted alias to its canonical name
+(reference: config.h:1087 ParameterAlias::KeyAliasTransform); ``Config.set``
+applies a params dict with alias resolution and type coercion
+(reference: src/io/config.cpp:196 Config::Set).
+"""
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Union
+
+from .utils.log import Log
+
+TaskType = str  # train | predict | convert_model | refit | save_binary
+
+
+def _parse_int_list(v: Any) -> List[int]:
+    if v is None or v == "":
+        return []
+    if isinstance(v, (list, tuple)):
+        return [int(x) for x in v]
+    return [int(x) for x in str(v).split(",") if x != ""]
+
+
+def _parse_float_list(v: Any) -> List[float]:
+    if v is None or v == "":
+        return []
+    if isinstance(v, (list, tuple)):
+        return [float(x) for x in v]
+    return [float(x) for x in str(v).split(",") if x != ""]
+
+
+def _parse_str_list(v: Any) -> List[str]:
+    if v is None or v == "":
+        return []
+    if isinstance(v, (list, tuple)):
+        return [str(x) for x in v]
+    return [s for s in str(v).split(",") if s != ""]
+
+
+def _parse_bool(v: Any) -> bool:
+    if isinstance(v, bool):
+        return v
+    if isinstance(v, (int, float)):
+        return bool(v)
+    s = str(v).strip().lower()
+    if s in ("true", "1", "yes", "y", "+"):
+        return True
+    if s in ("false", "0", "no", "n", "-"):
+        return False
+    raise ValueError("cannot parse bool from %r" % (v,))
+
+
+@dataclass
+class Config:
+    # ---- core (reference: config.h "Core Parameters") ----
+    task: TaskType = "train"
+    objective: str = "regression"
+    boosting: str = "gbdt"
+    data_sample_strategy: str = "bagging"  # bagging | goss
+    data: str = ""
+    valid: List[str] = field(default_factory=list)
+    num_iterations: int = 100
+    learning_rate: float = 0.1
+    num_leaves: int = 31
+    tree_learner: str = "serial"  # serial | feature | data | voting
+    num_threads: int = 0
+    device_type: str = "tpu"  # cpu | gpu | cuda | tpu — cpu/gpu/cuda accepted, all run the JAX backend
+    seed: Optional[int] = None
+    deterministic: bool = False
+
+    # ---- learning control (reference: config.h "Learning Control Parameters") ----
+    force_col_wise: bool = False
+    force_row_wise: bool = False
+    histogram_pool_size: float = -1.0
+    max_depth: int = -1
+    min_data_in_leaf: int = 20
+    min_sum_hessian_in_leaf: float = 1e-3
+    bagging_fraction: float = 1.0
+    pos_bagging_fraction: float = 1.0
+    neg_bagging_fraction: float = 1.0
+    bagging_freq: int = 0
+    bagging_seed: int = 3
+    feature_fraction: float = 1.0
+    feature_fraction_bynode: float = 1.0
+    feature_fraction_seed: int = 2
+    extra_trees: bool = False
+    extra_seed: int = 6
+    early_stopping_round: int = 0
+    first_metric_only: bool = False
+    max_delta_step: float = 0.0
+    lambda_l1: float = 0.0
+    lambda_l2: float = 0.0
+    min_gain_to_split: float = 0.0
+    drop_rate: float = 0.1
+    max_drop: int = 50
+    skip_drop: float = 0.5
+    xgboost_dart_mode: bool = False
+    uniform_drop: bool = False
+    drop_seed: int = 4
+    top_rate: float = 0.2
+    other_rate: float = 0.1
+    min_data_per_group: int = 100
+    max_cat_threshold: int = 32
+    cat_l2: float = 10.0
+    cat_smooth: float = 10.0
+    max_cat_to_onehot: int = 4
+    top_k: int = 20
+    monotone_constraints: List[int] = field(default_factory=list)
+    monotone_constraints_method: str = "basic"
+    monotone_penalty: float = 0.0
+    feature_contri: List[float] = field(default_factory=list)
+    forcedsplits_filename: str = ""
+    refit_decay_rate: float = 0.9
+    cegb_tradeoff: float = 1.0
+    cegb_penalty_split: float = 0.0
+    cegb_penalty_feature_lazy: List[float] = field(default_factory=list)
+    cegb_penalty_feature_coupled: List[float] = field(default_factory=list)
+    path_smooth: float = 0.0
+    interaction_constraints: str = ""
+    verbosity: int = 1
+    input_model: str = ""
+    output_model: str = "LightGBM_model.txt"
+    saved_feature_importance_type: int = 0
+    snapshot_freq: int = -1
+
+    # ---- linear tree ----
+    linear_tree: bool = False
+    linear_lambda: float = 0.0
+
+    # ---- dataset (reference: config.h "IO Parameters / Dataset") ----
+    max_bin: int = 255
+    max_bin_by_feature: List[int] = field(default_factory=list)
+    min_data_in_bin: int = 3
+    bin_construct_sample_cnt: int = 200000
+    data_random_seed: int = 1
+    is_enable_sparse: bool = True
+    enable_bundle: bool = True
+    use_missing: bool = True
+    zero_as_missing: bool = False
+    feature_pre_filter: bool = True
+    pre_partition: bool = False
+    two_round: bool = False
+    header: bool = False
+    label_column: str = ""
+    weight_column: str = ""
+    group_column: str = ""
+    ignore_column: str = ""
+    categorical_feature: Union[str, List[int]] = ""
+    forcedbins_filename: str = ""
+    save_binary: bool = False
+
+    # ---- predict ----
+    start_iteration_predict: int = 0
+    num_iteration_predict: int = -1
+    predict_raw_score: bool = False
+    predict_leaf_index: bool = False
+    predict_contrib: bool = False
+    predict_disable_shape_check: bool = False
+    pred_early_stop: bool = False
+    pred_early_stop_freq: int = 10
+    pred_early_stop_margin: float = 10.0
+    output_result: str = "LightGBM_predict_result.txt"
+
+    # ---- objective (reference: config.h "Objective Parameters") ----
+    num_class: int = 1
+    is_unbalance: bool = False
+    scale_pos_weight: float = 1.0
+    sigmoid: float = 1.0
+    boost_from_average: bool = True
+    reg_sqrt: bool = False
+    alpha: float = 0.9
+    fair_c: float = 1.0
+    poisson_max_delta_step: float = 0.7
+    tweedie_variance_power: float = 1.5
+    lambdarank_truncation_level: int = 30
+    lambdarank_norm: bool = True
+    label_gain: List[float] = field(default_factory=list)
+    objective_seed: int = 5
+
+    # ---- metric ----
+    metric: List[str] = field(default_factory=list)
+    metric_freq: int = 1
+    is_provide_training_metric: bool = False
+    eval_at: List[int] = field(default_factory=lambda: [1, 2, 3, 4, 5])
+    multi_error_top_k: int = 1
+    auc_mu_weights: List[float] = field(default_factory=list)
+
+    # ---- network (reference: config.h "Network Parameters"; here: jax.distributed) ----
+    num_machines: int = 1
+    local_listen_port: int = 12400
+    time_out: int = 120
+    machine_list_filename: str = ""
+    machines: str = ""
+
+    # ---- device ----
+    gpu_platform_id: int = -1
+    gpu_device_id: int = -1
+    gpu_use_dp: bool = False
+    num_gpu: int = 1
+    # TPU-specific knobs (no reference analog):
+    tpu_hist_dtype: str = "float32"  # histogram accumulation dtype
+    tpu_rows_per_chunk: int = 65536  # rows per device histogram chunk
+    tpu_donate_buffers: bool = True
+
+    # resolved, not user-set
+    num_original_features: int = 0
+
+    def __post_init__(self) -> None:
+        # direct-constructor path must validate/normalize too (goss -> gbdt+goss)
+        self._check()
+
+    def set(self, params: Dict[str, Any]) -> "Config":
+        """Apply a params dict (with aliases) onto this config in place.
+
+        Mirrors reference Config::Set (src/io/config.cpp:196): alias
+        resolution first, then typed assignment; unknown keys warn.
+        """
+        resolved = resolve_aliases(params)
+        fields = {f.name: f for f in dataclasses.fields(self)}
+        for key, value in resolved.items():
+            if key not in fields:
+                Log.warning("Unknown parameter: %s", key)
+                continue
+            f = fields[key]
+            try:
+                setattr(self, key, _coerce(f, value))
+            except (TypeError, ValueError) as exc:
+                Log.fatal('Parameter %s cannot be set to %r: %s', key, value, exc)
+        self._check()
+        return self
+
+    def _check(self) -> None:
+        """Constraint checks (reference: src/io/config.cpp Config::CheckParamConflict)."""
+        if self.num_leaves < 2:
+            Log.fatal("num_leaves must be >= 2, got %d", self.num_leaves)
+        if self.max_bin < 2:
+            Log.fatal("max_bin must be >= 2, got %d", self.max_bin)
+        if not 0.0 < self.bagging_fraction <= 1.0:
+            Log.fatal("bagging_fraction must be in (0, 1]")
+        if not 0.0 < self.feature_fraction <= 1.0:
+            Log.fatal("feature_fraction must be in (0, 1]")
+        if self.boosting == "goss":
+            # reference treats boosting=goss as gbdt + goss sampling
+            self.boosting = "gbdt"
+            self.data_sample_strategy = "goss"
+        if self.boosting == "rf":
+            if self.bagging_freq <= 0 or self.bagging_fraction >= 1.0 or self.bagging_fraction <= 0.0:
+                Log.fatal("RF mode requires 0 < bagging_fraction < 1 and bagging_freq > 0")
+        if self.data_sample_strategy == "goss" and self.top_rate + self.other_rate > 1.0:
+            Log.fatal("GOSS requires top_rate + other_rate <= 1.0")
+        if self.objective in ("multiclass", "multiclassova", "softmax", "ova") and self.num_class <= 1:
+            Log.fatal("num_class must be > 1 for multiclass objectives")
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_params(cls, params: Optional[Dict[str, Any]]) -> "Config":
+        cfg = cls()
+        if params:
+            cfg.set(params)
+        return cfg
+
+    def clone(self) -> "Config":
+        return dataclasses.replace(self)
+
+
+def _coerce(f: dataclasses.Field, value: Any) -> Any:
+    t = str(f.type)
+    if t == "int":
+        return int(value)
+    if t == "float":
+        return float(value)
+    if t == "bool":
+        return _parse_bool(value)
+    if t in ("str", "TaskType"):
+        return str(value)
+    if t == "Optional[int]":
+        return None if value is None or value == "" else int(value)
+    if t == "List[int]":
+        return _parse_int_list(value)
+    if t == "List[float]":
+        return _parse_float_list(value)
+    if t == "List[str]":
+        return _parse_str_list(value)
+    return value
+
+
+# Alias -> canonical map. Mirrors the generated table in the reference
+# (src/io/config_auto.cpp:6-180 "parameter2aliases").
+ALIASES: Dict[str, str] = {}
+
+
+def _alias(canonical: str, *names: str) -> None:
+    for n in names:
+        ALIASES[n] = canonical
+
+
+_alias("config", "config_file")
+_alias("task", "task_type")
+_alias("objective", "objective_type", "app", "application", "loss")
+_alias("boosting", "boosting_type", "boost")
+_alias("data", "train", "train_data", "train_data_file", "data_filename")
+_alias("valid", "test", "valid_data", "valid_data_file", "test_data", "test_data_file", "valid_filenames")
+_alias("num_iterations", "num_iteration", "n_iter", "num_tree", "num_trees", "num_round",
+       "num_rounds", "nrounds", "num_boost_round", "n_estimators", "max_iter")
+_alias("learning_rate", "shrinkage_rate", "eta")
+_alias("num_leaves", "num_leaf", "max_leaves", "max_leaf", "max_leaf_nodes")
+_alias("tree_learner", "tree", "tree_type", "tree_learner_type")
+_alias("num_threads", "num_thread", "nthread", "nthreads", "n_jobs")
+_alias("device_type", "device")
+_alias("seed", "random_seed", "random_state")
+_alias("max_depth", "max_tree_depth")
+_alias("min_data_in_leaf", "min_data_per_leaf", "min_data", "min_child_samples", "min_samples_leaf")
+_alias("min_sum_hessian_in_leaf", "min_sum_hessian_per_leaf", "min_sum_hessian", "min_hessian",
+       "min_child_weight")
+_alias("bagging_fraction", "sub_row", "subsample", "bagging")
+_alias("pos_bagging_fraction", "pos_sub_row", "pos_subsample", "pos_bagging")
+_alias("neg_bagging_fraction", "neg_sub_row", "neg_subsample", "neg_bagging")
+_alias("bagging_freq", "subsample_freq")
+_alias("bagging_seed", "bagging_fraction_seed")
+_alias("feature_fraction", "sub_feature", "colsample_bytree")
+_alias("feature_fraction_bynode", "sub_feature_bynode", "colsample_bynode")
+_alias("extra_trees", "extra_tree")
+_alias("early_stopping_round", "early_stopping_rounds", "early_stopping", "n_iter_no_change")
+_alias("lambda_l1", "reg_alpha", "l1_regularization")
+_alias("lambda_l2", "reg_lambda", "lambda", "l2_regularization")
+_alias("min_gain_to_split", "min_split_gain")
+_alias("drop_rate", "rate_drop")
+_alias("top_k", "topk")
+_alias("monotone_constraints", "mc", "monotone_constraint", "monotonic_cst")
+_alias("monotone_constraints_method", "monotone_constraining_method", "mc_method")
+_alias("monotone_penalty", "monotone_splits_penalty", "ms_penalty", "mc_penalty")
+_alias("feature_contri", "feature_contrib", "fc", "fp", "feature_penalty")
+_alias("forcedsplits_filename", "fs", "forced_splits_filename", "forced_splits_file", "forced_splits")
+_alias("verbosity", "verbose")
+_alias("input_model", "model_input", "model_in")
+_alias("output_model", "model_output", "model_out")
+_alias("snapshot_freq", "save_period")
+_alias("max_bin", "max_bins")
+_alias("bin_construct_sample_cnt", "subsample_for_bin")
+_alias("data_random_seed", "data_seed")
+_alias("is_enable_sparse", "is_sparse", "enable_sparse", "sparse")
+_alias("enable_bundle", "is_enable_bundle", "bundle")
+_alias("pre_partition", "is_pre_partition")
+_alias("two_round", "two_round_loading", "use_two_round_loading")
+_alias("header", "has_header")
+_alias("label_column", "label")
+_alias("weight_column", "weight")
+_alias("group_column", "group", "group_id", "query_column", "query", "query_id")
+_alias("ignore_column", "ignore_feature", "blacklist")
+_alias("categorical_feature", "cat_feature", "categorical_column", "cat_column")
+_alias("save_binary", "is_save_binary", "is_save_binary_file")
+_alias("predict_raw_score", "is_predict_raw_score", "predict_rawscore", "raw_score")
+_alias("predict_leaf_index", "is_predict_leaf_index", "leaf_index")
+_alias("predict_contrib", "is_predict_contrib", "contrib")
+_alias("output_result", "predict_result", "prediction_result", "predict_name",
+       "prediction_name", "pred_name", "name_pred")
+_alias("num_class", "num_classes")
+_alias("is_unbalance", "unbalance", "unbalanced_sets")
+_alias("scale_pos_weight", "scale_pos_weight")
+_alias("sigmoid", "sigmoid")
+_alias("metric", "metrics", "metric_types")
+_alias("metric_freq", "output_freq")
+_alias("is_provide_training_metric", "training_metric", "is_training_metric", "train_metric")
+_alias("eval_at", "ndcg_eval_at", "ndcg_at", "map_eval_at", "map_at")
+_alias("num_machines", "num_machine")
+_alias("local_listen_port", "local_port", "port")
+_alias("machine_list_filename", "machine_list_file", "machine_list", "mlist")
+_alias("machines", "workers", "nodes")
+
+
+def resolve_aliases(params: Dict[str, Any]) -> Dict[str, Any]:
+    """Resolve aliases; canonical names win over aliases on conflict
+    (mirrors python-package _ConfigAliases precedence, basic.py:258)."""
+    out: Dict[str, Any] = {}
+    canonical_present = set()
+    for key in params:
+        if key in ALIASES and ALIASES[key] != key:
+            continue
+        canonical_present.add(key)
+    for key, value in params.items():
+        canon = ALIASES.get(key, key)
+        if canon != key and canon in canonical_present:
+            continue  # explicit canonical setting wins
+        if canon in out and key in ALIASES and ALIASES[key] != key:
+            continue  # first alias wins among aliases
+        out[canon] = value
+    return out
+
+
+# objective aliases (reference: src/objective/objective_function.cpp:15-53 name matching)
+OBJECTIVE_ALIASES = {
+    "regression": "regression",
+    "regression_l2": "regression",
+    "l2": "regression",
+    "mean_squared_error": "regression",
+    "mse": "regression",
+    "l2_root": "regression",
+    "root_mean_squared_error": "regression",
+    "rmse": "regression",
+    "regression_l1": "regression_l1",
+    "l1": "regression_l1",
+    "mean_absolute_error": "regression_l1",
+    "mae": "regression_l1",
+    "huber": "huber",
+    "fair": "fair",
+    "poisson": "poisson",
+    "quantile": "quantile",
+    "mape": "mape",
+    "mean_absolute_percentage_error": "mape",
+    "gamma": "gamma",
+    "tweedie": "tweedie",
+    "binary": "binary",
+    "multiclass": "multiclass",
+    "softmax": "multiclass",
+    "multiclassova": "multiclassova",
+    "multiclass_ova": "multiclassova",
+    "ova": "multiclassova",
+    "ovr": "multiclassova",
+    "cross_entropy": "cross_entropy",
+    "xentropy": "cross_entropy",
+    "cross_entropy_lambda": "cross_entropy_lambda",
+    "xentlambda": "cross_entropy_lambda",
+    "lambdarank": "lambdarank",
+    "rank_xendcg": "rank_xendcg",
+    "xendcg": "rank_xendcg",
+    "xe_ndcg": "rank_xendcg",
+    "xe_ndcg_mart": "rank_xendcg",
+    "xendcg_mart": "rank_xendcg",
+    "none": "none",
+    "null": "none",
+    "custom": "none",
+    "na": "none",
+}

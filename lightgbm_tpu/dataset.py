@@ -1,0 +1,366 @@
+"""Binned training dataset: the device-resident training matrix.
+
+TPU-native equivalent of the reference ``Dataset`` + ``DatasetLoader`` +
+``Metadata`` (reference: include/LightGBM/dataset.h:41,282,
+src/io/dataset.cpp:318 Construct, src/io/dataset_loader.cpp). Differences by
+design:
+
+- The binned matrix is a single dense ``(rows, features)`` uint8/uint16 array
+  destined for HBM (row-sharded over the device mesh), instead of per-group
+  column bins (dense_bin.hpp / sparse_bin.hpp). All features share one padded
+  bin axis; per-feature bin counts mask the tail during the split scan.
+- EFB (reference dataset.cpp:239 FastFeatureBundling) folds mutually-exclusive
+  sparse features into shared columns before the matrix is materialized.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .config import Config
+from .ops.binning import (
+    BIN_CATEGORICAL,
+    BIN_NUMERICAL,
+    MISSING_NAN,
+    MISSING_NONE,
+    MISSING_ZERO,
+    BinMapper,
+    find_bin,
+)
+from .utils.log import Log
+
+
+class Metadata:
+    """Labels, weights, query boundaries, init scores
+    (reference: include/LightGBM/dataset.h:41, src/io/metadata.cpp)."""
+
+    def __init__(
+        self,
+        num_data: int,
+        label: Optional[np.ndarray] = None,
+        weight: Optional[np.ndarray] = None,
+        group: Optional[np.ndarray] = None,
+        init_score: Optional[np.ndarray] = None,
+    ) -> None:
+        self.num_data = num_data
+        self.label = None if label is None else np.ascontiguousarray(label, dtype=np.float32).ravel()
+        self.weight = None if weight is None else np.ascontiguousarray(weight, dtype=np.float32).ravel()
+        self.init_score = None if init_score is None else np.ascontiguousarray(init_score, dtype=np.float64)
+        self.query_boundaries: Optional[np.ndarray] = None
+        self.query_id: Optional[np.ndarray] = None
+        if group is not None:
+            group = np.asarray(group).ravel().astype(np.int64)
+            # LightGBM semantics: `group` is per-query sizes summing to
+            # num_data (reference src/io/metadata.cpp SetQuery). A per-row
+            # query-id vector is also accepted (sklearn-API convenience) but
+            # only when it cannot be a sizes vector and ids are contiguous.
+            if group.sum() == num_data:
+                sizes = group
+                if np.any(sizes <= 0):
+                    Log.fatal("group sizes must be positive")
+                self.query_boundaries = np.concatenate([[0], np.cumsum(sizes)]).astype(np.int64)
+            elif len(group) == num_data:
+                qid = group
+                change = np.flatnonzero(np.diff(qid)) + 1
+                boundaries = np.concatenate([[0], change, [num_data]]).astype(np.int64)
+                # reject non-contiguous ids (same id reappearing later)
+                first_vals = qid[boundaries[:-1]]
+                if len(np.unique(first_vals)) != len(first_vals):
+                    Log.fatal("per-row query ids must be contiguous (sorted by query)")
+                self.query_boundaries = boundaries
+            else:
+                Log.fatal("sum of group sizes (%d) != num_data (%d)", group.sum(), num_data)
+            qb = self.query_boundaries
+            qid = np.zeros(num_data, dtype=np.int32)
+            for i in range(len(qb) - 1):
+                qid[qb[i]:qb[i + 1]] = i
+            self.query_id = qid
+
+    @property
+    def num_queries(self) -> int:
+        return 0 if self.query_boundaries is None else len(self.query_boundaries) - 1
+
+
+@dataclass
+class FeatureGroupInfo:
+    """One bundled column of the binned matrix (EFB bundle or single feature).
+
+    Reference analog: FeatureGroup (include/LightGBM/feature_group.h:25) —
+    features in a bundle are mutually exclusive; each sub-feature occupies a
+    contiguous bin range [bin_offset, bin_offset + num_bins) in the column.
+    """
+    feature_indices: List[int]      # inner (used-feature) indices in this bundle
+    bin_offsets: List[int]          # per sub-feature offset within the column
+    num_bins: int                   # total bins in this column
+
+
+class BinnedDataset:
+    """The constructed training matrix (reference Dataset, dataset.h:282)."""
+
+    def __init__(self) -> None:
+        self.num_data: int = 0
+        self.num_total_features: int = 0      # original input feature count
+        self.used_feature_indices: List[int] = []   # original index per used feature
+        self.bin_mappers: List[BinMapper] = []      # per used feature
+        self.binned: Optional[np.ndarray] = None    # (num_data, num_groups) uint8/16
+        self.groups: List[FeatureGroupInfo] = []
+        self.feature_to_group: np.ndarray = np.array([], dtype=np.int32)   # used-feature -> group
+        self.feature_group_offset: np.ndarray = np.array([], dtype=np.int32)  # bin offset in group
+        self.metadata: Metadata = Metadata(0)
+        self.max_bins_per_feature: int = 0
+        self.feature_names: List[str] = []
+        self.monotone_constraints: Optional[np.ndarray] = None
+        self.feature_penalty: Optional[np.ndarray] = None
+
+    # -- accessors used by the learners --
+    @property
+    def num_features(self) -> int:
+        return len(self.bin_mappers)
+
+    def feature_num_bins(self) -> np.ndarray:
+        return np.array([m.num_bins for m in self.bin_mappers], dtype=np.int32)
+
+    def real_feature_index(self, inner: int) -> int:
+        return self.used_feature_indices[inner]
+
+    def inner_feature_index(self, real: int) -> int:
+        try:
+            return self.used_feature_indices.index(real)
+        except ValueError:
+            return -1
+
+
+def _resolve_categorical(
+    categorical_feature: Union[str, Sequence[Union[int, str]], None],
+    num_features: int,
+    feature_names: List[str],
+) -> List[int]:
+    if categorical_feature is None or categorical_feature == "" or categorical_feature == "auto":
+        return []
+    if isinstance(categorical_feature, str):
+        items: List[Any] = [s for s in categorical_feature.split(",") if s]
+    else:
+        items = list(categorical_feature)
+    out: List[int] = []
+    for it in items:
+        if isinstance(it, str) and not it.lstrip("-").isdigit():
+            if it.startswith("name:"):
+                it = it[5:]
+            if it in feature_names:
+                out.append(feature_names.index(it))
+            else:
+                Log.warning("Unknown categorical feature name: %s", it)
+        else:
+            out.append(int(it))
+    return sorted(set(i for i in out if 0 <= i < num_features))
+
+
+def construct_dataset(
+    X: np.ndarray,
+    config: Config,
+    *,
+    label: Optional[np.ndarray] = None,
+    weight: Optional[np.ndarray] = None,
+    group: Optional[np.ndarray] = None,
+    init_score: Optional[np.ndarray] = None,
+    feature_names: Optional[List[str]] = None,
+    categorical_feature: Union[str, Sequence[Union[int, str]], None] = None,
+    reference: Optional[BinnedDataset] = None,
+) -> BinnedDataset:
+    """Build a BinnedDataset from a raw feature matrix.
+
+    Reference analog: DatasetLoader::LoadFromFile + Dataset::Construct
+    (src/io/dataset_loader.cpp:182, src/io/dataset.cpp:318): sample rows for
+    bin finding, fit BinMappers, drop trivial features, bundle (EFB), then
+    extract (bin) all rows. When ``reference`` is given, reuse its bin mappers
+    (validation sets must share the training set's binning —
+    reference: LoadFromFileAlignWithOtherDataset, dataset_loader.cpp:261).
+    """
+    X = np.asarray(X)
+    if X.ndim != 2:
+        raise ValueError("X must be 2-dimensional, got shape %s" % (X.shape,))
+    num_data, num_total = X.shape
+    ds = BinnedDataset()
+    ds.num_data = num_data
+    ds.num_total_features = num_total
+    ds.feature_names = feature_names or ["Column_%d" % i for i in range(num_total)]
+
+    if reference is not None:
+        ds.used_feature_indices = list(reference.used_feature_indices)
+        ds.bin_mappers = reference.bin_mappers
+        ds.groups = reference.groups
+        ds.feature_to_group = reference.feature_to_group
+        ds.feature_group_offset = reference.feature_group_offset
+        ds.max_bins_per_feature = reference.max_bins_per_feature
+        ds.feature_names = reference.feature_names
+        ds.monotone_constraints = reference.monotone_constraints
+        ds.feature_penalty = reference.feature_penalty
+        ds.binned = _extract_binned(X, ds)
+        ds.metadata = Metadata(num_data, label, weight, group, init_score)
+        return ds
+
+    cat_idx = set(_resolve_categorical(categorical_feature if categorical_feature is not None
+                                       else config.categorical_feature,
+                                       num_total, ds.feature_names))
+
+    # ---- sampling for bin finding (reference: bin_construct_sample_cnt,
+    # dataset_loader.cpp:903 SampleTextDataFromFile) ----
+    sample_cnt = min(num_data, int(config.bin_construct_sample_cnt))
+    rng = np.random.RandomState(config.data_random_seed)
+    if sample_cnt < num_data:
+        sample_idx = rng.choice(num_data, size=sample_cnt, replace=False)
+        sample_idx.sort()
+    else:
+        sample_idx = np.arange(num_data)
+    X_sample = np.asarray(X[sample_idx], dtype=np.float64)
+
+    # per-feature max_bin override (reference: max_bin_by_feature, config.h)
+    max_bin_by_feature = config.max_bin_by_feature
+    min_split_data = 0
+    if config.feature_pre_filter:
+        # features that cannot split given min_data_in_leaf are trivial
+        min_split_data = int(config.min_data_in_leaf * sample_cnt / max(1, num_data))
+
+    mappers: List[BinMapper] = []
+    used: List[int] = []
+    for f in range(num_total):
+        mb = (max_bin_by_feature[f] if f < len(max_bin_by_feature) else config.max_bin)
+        m = find_bin(
+            X_sample[:, f],
+            sample_cnt,
+            mb,
+            config.min_data_in_bin,
+            bin_type=BIN_CATEGORICAL if f in cat_idx else BIN_NUMERICAL,
+            use_missing=config.use_missing,
+            zero_as_missing=config.zero_as_missing,
+            min_split_data=min_split_data,
+        )
+        if m.is_trivial:
+            continue
+        mappers.append(m)
+        used.append(f)
+    if not mappers:
+        Log.warning("All features are trivial; training will produce constant predictions")
+    ds.bin_mappers = mappers
+    ds.used_feature_indices = used
+
+    # ---- EFB bundling decision (reference: dataset.cpp:239 FastFeatureBundling) ----
+    ds.groups, ds.feature_to_group, ds.feature_group_offset = _make_groups(
+        ds, X_sample, used, mappers, enable_bundle=config.enable_bundle
+    )
+    ds.max_bins_per_feature = max((g.num_bins for g in ds.groups), default=1)
+
+    # monotone constraints / feature penalties mapped to used features
+    if config.monotone_constraints:
+        mc = np.zeros(len(used), dtype=np.int8)
+        for i, f in enumerate(used):
+            if f < len(config.monotone_constraints):
+                mc[i] = np.sign(config.monotone_constraints[f])
+        if np.any(mc != 0):
+            ds.monotone_constraints = mc
+    if config.feature_contri:
+        fp = np.ones(len(used), dtype=np.float32)
+        for i, f in enumerate(used):
+            if f < len(config.feature_contri):
+                fp[i] = config.feature_contri[f]
+        ds.feature_penalty = fp
+
+    ds.binned = _extract_binned(X, ds)
+    ds.metadata = Metadata(num_data, label, weight, group, init_score)
+    return ds
+
+
+def _make_groups(
+    ds: BinnedDataset,
+    X_sample: np.ndarray,
+    used: List[int],
+    mappers: List[BinMapper],
+    *,
+    enable_bundle: bool,
+    max_conflict_rate: float = 0.0,
+) -> tuple:
+    """Greedy exclusive-feature bundling (reference: Dataset::FindGroups,
+    src/io/dataset.cpp:100 — greedy graph coloring by conflict count).
+
+    Only sufficiently sparse features are bundling candidates; dense features
+    get their own group. Conflicts are counted on the sample: two features
+    conflict on a row if both are away from their most-frequent (default) bin.
+    """
+    n = len(used)
+    sparse_ok = [enable_bundle and m.sparse_rate >= 0.8 and m.bin_type == BIN_NUMERICAL
+                 for m in mappers]
+    groups: List[FeatureGroupInfo] = []
+    feature_to_group = np.zeros(n, dtype=np.int32)
+    feature_offset = np.zeros(n, dtype=np.int32)
+
+    # nonzero masks on the sample for bundling candidates
+    bundles: List[List[int]] = []
+    bundle_masks: List[np.ndarray] = []
+    sample_total = X_sample.shape[0]
+    max_conflicts = int(max_conflict_rate * sample_total)
+    for i in range(n):
+        if not sparse_ok[i]:
+            continue
+        col = X_sample[:, used[i]]
+        nz = np.abs(np.nan_to_num(col, nan=1.0)) > 1e-35
+        placed = False
+        for b, mask in enumerate(bundle_masks):
+            if len(bundles[b]) >= 255:
+                continue
+            conflicts = int(np.count_nonzero(mask & nz))
+            if conflicts <= max_conflicts:
+                bundles[b].append(i)
+                bundle_masks[b] = mask | nz
+                placed = True
+                break
+        if not placed:
+            bundles.append([i])
+            bundle_masks.append(nz)
+
+    # only multi-feature bundles count as bundles
+    multi = [b for b in bundles if len(b) > 1]
+    in_multi = set(i for b in multi for i in b)
+
+    gid = 0
+    for b in multi:
+        offsets: List[int] = []
+        # bin 0 of the bundle = "all defaults"; each sub-feature's non-default
+        # bins occupy [off, off + (num_bins-1))
+        off = 1
+        for i in b:
+            offsets.append(off)
+            off += mappers[i].num_bins - 1
+        groups.append(FeatureGroupInfo([int(i) for i in b], offsets, off))
+        for i, o in zip(b, offsets):
+            feature_to_group[i] = gid
+            feature_offset[i] = o
+        gid += 1
+    for i in range(n):
+        if i in in_multi:
+            continue
+        groups.append(FeatureGroupInfo([i], [0], mappers[i].num_bins))
+        feature_to_group[i] = gid
+        feature_offset[i] = 0
+        gid += 1
+    return groups, feature_to_group, feature_offset
+
+
+def _extract_binned(X: np.ndarray, ds: BinnedDataset) -> np.ndarray:
+    """Bin every row into the (num_data, num_features) matrix.
+
+    NOTE on layout: the training matrix is per-used-feature (one column per
+    feature, not per group). EFB groups are honored at histogram time via
+    shared columns when beneficial; for the dense TPU path a plain
+    per-feature column keeps the one-hot histogram indexing uniform.
+    """
+    num_data = X.shape[0]
+    F = ds.num_features
+    max_bins = max((m.num_bins for m in ds.bin_mappers), default=1)
+    dtype = np.uint8 if max_bins <= 256 else np.uint16
+    out = np.zeros((num_data, F), dtype=dtype)
+    Xv = np.asarray(X, dtype=np.float64)
+    for i, (f, m) in enumerate(zip(ds.used_feature_indices, ds.bin_mappers)):
+        out[:, i] = m.value_to_bin(Xv[:, f]).astype(dtype)
+    return out

@@ -1,0 +1,303 @@
+"""Feature discretization: value -> bin mapping.
+
+TPU-native equivalent of the reference's ``BinMapper``
+(reference: include/LightGBM/bin.h:61, src/io/bin.cpp:325 FindBin):
+equal-density numerical bins found from sampled values, a dedicated zero bin,
+categorical bin dictionaries sorted by frequency, missing-value handling
+(None/Zero/NaN, reference bin.h:26), per-feature max_bin override, and
+trivial-feature detection.
+
+Host-side (numpy): binning runs once at Dataset construction; the result is a
+uint8/uint16 (rows, features) matrix that lives in device HBM.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+# Values with |x| <= kZeroThreshold fall into the zero bin
+# (reference: include/LightGBM/bin.h:33 kZeroThreshold = 1e-35).
+K_ZERO_THRESHOLD = 1e-35
+
+# missing handling modes (reference: include/LightGBM/bin.h:26 MissingType)
+MISSING_NONE = 0
+MISSING_ZERO = 1
+MISSING_NAN = 2
+
+BIN_NUMERICAL = 0
+BIN_CATEGORICAL = 1
+
+
+@dataclass
+class BinMapper:
+    """Per-feature value->bin discretizer."""
+
+    num_bins: int = 1
+    bin_type: int = BIN_NUMERICAL
+    missing_type: int = MISSING_NONE
+    is_trivial: bool = True
+    # numerical: bin k covers (upper_bounds[k-1], upper_bounds[k]]
+    upper_bounds: np.ndarray = field(default_factory=lambda: np.array([np.inf]))
+    # categorical: bin index -> category value (sorted by descending frequency)
+    categories: np.ndarray = field(default_factory=lambda: np.array([], dtype=np.int64))
+    default_bin: int = 0       # bin of the value 0.0 (reference bin.h:138 GetDefaultBin)
+    most_freq_bin: int = 0     # bin with the most sampled data (reference bin.h:144)
+    missing_bin: int = 0       # bin holding missing values (NaN bin or zero bin)
+    sparse_rate: float = 0.0   # fraction of zeros in the sample (drives EFB)
+    min_value: float = 0.0
+    max_value: float = 0.0
+
+    # lazy sorted views for vectorized categorical lookup
+    _sorted_cats: Optional[np.ndarray] = None
+    _sorted_order: Optional[np.ndarray] = None
+
+    def value_to_bin(self, values: np.ndarray) -> np.ndarray:
+        """Vectorized value->bin (reference: bin.h:464 ValueToBin binary search)."""
+        values = np.asarray(values, dtype=np.float64)
+        if self.bin_type == BIN_CATEGORICAL:
+            if len(self.categories) == 0:
+                return np.full(values.shape, self.missing_bin, dtype=np.int64)
+            if self._sorted_cats is None:
+                self._sorted_order = np.argsort(self.categories, kind="stable")
+                self._sorted_cats = self.categories[self._sorted_order]
+            ivals = np.where(np.isfinite(values), values, -1).astype(np.int64)
+            pos = np.searchsorted(self._sorted_cats, ivals)
+            pos = np.clip(pos, 0, len(self.categories) - 1)
+            hit = self._sorted_cats[pos] == ivals
+            out = np.where(hit, self._sorted_order[pos], self.missing_bin)
+            return out.astype(np.int64)
+        # numerical
+        nan_mask = np.isnan(values)
+        if self.missing_type != MISSING_NAN:
+            # Zero/None: NaN is treated as zero (reference bin.h ValueToBin)
+            values = np.where(nan_mask, 0.0, values)
+        bins = np.searchsorted(self.upper_bounds, values, side="left")
+        bins = np.minimum(bins, self.num_bins - 1)
+        if self.missing_type == MISSING_NAN:
+            bins = np.where(nan_mask, self.missing_bin, bins)
+        return bins.astype(np.int64)
+
+    def bin_to_value(self, b: int) -> float:
+        """Representative threshold value for a bin upper bound (used for
+        model serialization; reference stores real-valued thresholds in trees)."""
+        if self.bin_type == BIN_CATEGORICAL:
+            if 0 <= b < len(self.categories):
+                return float(self.categories[b])
+            return -1.0
+        return float(self.upper_bounds[min(b, self.num_bins - 1)])
+
+
+def _greedy_find_bin(
+    distinct_values: np.ndarray,
+    counts: np.ndarray,
+    total_cnt: int,
+    max_bin: int,
+    min_data_in_bin: int,
+) -> List[float]:
+    """Equal-density bin upper bounds over distinct sampled values.
+
+    Re-derivation of the reference's GreedyFindBin (src/io/bin.cpp:87):
+    if few distinct values each gets its own bin; otherwise target
+    mean_bin_size = cnt/max_bin with min_data_in_bin enforced, and any
+    distinct value whose count exceeds mean_bin_size is forced into its own
+    bin ("big" values), re-computing the mean over the rest.
+    """
+    n = len(distinct_values)
+    bounds: List[float] = []
+    if n == 0:
+        return [np.inf]
+    if n <= max_bin:
+        cur = 0
+        for i in range(n - 1):
+            cur += counts[i]
+            if cur >= min_data_in_bin or min_data_in_bin <= 1:
+                bounds.append((distinct_values[i] + distinct_values[i + 1]) / 2.0)
+                cur = 0
+        bounds.append(np.inf)
+        return bounds
+    # more distinct values than bins: equal-density with "big value" carve-out
+    max_bin = max(1, max_bin)
+    mean_size = total_cnt / max_bin
+    is_big = counts > mean_size
+    rest_cnt = total_cnt - counts[is_big].sum()
+    rest_bins = max_bin - int(is_big.sum())
+    if rest_bins > 0:
+        mean_size = rest_cnt / rest_bins
+    else:
+        mean_size = np.inf
+    bin_cnt = 0.0
+    for i in range(n):
+        bin_cnt += counts[i]
+        close_bin = False
+        if is_big[i]:
+            close_bin = True
+        elif bin_cnt >= mean_size and bin_cnt >= min_data_in_bin:
+            close_bin = True
+        elif i + 1 < n and is_big[i + 1] and bin_cnt >= max(1, min_data_in_bin):
+            close_bin = True
+        if close_bin and i + 1 < n:
+            bounds.append((distinct_values[i] + distinct_values[i + 1]) / 2.0)
+            bin_cnt = 0.0
+        if len(bounds) >= max_bin - 1:
+            break
+    bounds.append(np.inf)
+    return bounds
+
+
+def find_bin(
+    sample_values: np.ndarray,
+    total_sample_cnt: int,
+    max_bin: int,
+    min_data_in_bin: int = 3,
+    *,
+    bin_type: int = BIN_NUMERICAL,
+    use_missing: bool = True,
+    zero_as_missing: bool = False,
+    forced_bounds: Optional[Sequence[float]] = None,
+    min_split_data: int = 0,
+) -> BinMapper:
+    """Find the bin mapping for one feature from sampled values.
+
+    Mirrors reference BinMapper::FindBin (src/io/bin.cpp:325). ``sample_values``
+    are the sampled raw values INCLUDING zeros and NaNs; ``total_sample_cnt``
+    is the number of sampled rows (zeros may be implicit in sparse input — the
+    difference ``total_sample_cnt - len(sample_values)`` counts as zeros).
+    """
+    m = BinMapper()
+    m.bin_type = bin_type
+    vals = np.asarray(sample_values, dtype=np.float64).ravel()
+    na_cnt = int(np.isnan(vals).sum())
+    vals = vals[~np.isnan(vals)]
+    implicit_zero = max(0, total_sample_cnt - len(vals) - na_cnt)
+    zero_cnt = int((np.abs(vals) <= K_ZERO_THRESHOLD).sum()) + implicit_zero
+
+    if bin_type == BIN_CATEGORICAL:
+        return _find_bin_categorical(m, vals, na_cnt, zero_cnt, max_bin, min_data_in_bin,
+                                     total_sample_cnt)
+
+    # ---- numerical ----
+    if zero_as_missing:
+        # zeros are missing: they join NaN in the zero bin (reference FindBin
+        # with zero_as_missing: missing_type = Zero). The zero bin must still
+        # be reserved — zero_cnt keeps counting so the bin layout below
+        # allocates it and sparse_rate/EFB stay correct.
+        na_cnt += zero_cnt
+        m.missing_type = MISSING_ZERO
+    elif not use_missing:
+        m.missing_type = MISSING_NONE
+        # NaNs treated as zeros
+        zero_cnt += na_cnt
+        na_cnt = 0
+    elif na_cnt > 0:
+        m.missing_type = MISSING_NAN
+    else:
+        m.missing_type = MISSING_NONE
+
+    nonzero = vals[np.abs(vals) > K_ZERO_THRESHOLD]
+    m.min_value = float(nonzero.min()) if len(nonzero) else 0.0
+    m.max_value = float(nonzero.max()) if len(nonzero) else 0.0
+
+    n_avail = max_bin - (1 if m.missing_type == MISSING_NAN else 0)
+    if forced_bounds is not None and len(forced_bounds) > 0:
+        inner = sorted(float(b) for b in forced_bounds)
+        bounds = [b for b in inner if b < np.inf] + [np.inf]
+        bounds = sorted(set(bounds))
+    else:
+        neg = nonzero[nonzero < -K_ZERO_THRESHOLD]
+        pos = nonzero[nonzero > K_ZERO_THRESHOLD]
+        # split bin budget between negative / zero / positive regions by density
+        # then merge (reference FindBinWithZeroAsOneBin: zero always gets one bin)
+        total_for_density = len(neg) + len(pos) + (zero_cnt if zero_cnt > 0 else 0)
+        if total_for_density == 0:
+            total_for_density = 1
+        bounds_list: List[float] = []
+        n_zero_bin = 1 if zero_cnt > 0 or (len(neg) and len(pos)) else 0
+        budget = max(1, n_avail - n_zero_bin)
+        n_neg_bins = int(round(budget * (len(neg) / total_for_density))) if len(neg) else 0
+        n_pos_bins = budget - n_neg_bins
+        if len(neg):
+            dv, cnts = np.unique(neg, return_counts=True)
+            b = _greedy_find_bin(dv, cnts, len(neg), max(1, n_neg_bins), min_data_in_bin)
+            bounds_list.extend(x for x in b if x < np.inf)
+            bounds_list.append(-K_ZERO_THRESHOLD)  # close the negative region
+        if n_zero_bin and len(pos):
+            bounds_list.append(K_ZERO_THRESHOLD)   # zero bin (−kzt, +kzt]
+        if len(pos):
+            dv, cnts = np.unique(pos, return_counts=True)
+            b = _greedy_find_bin(dv, cnts, len(pos), max(1, n_pos_bins), min_data_in_bin)
+            bounds_list.extend(x for x in b if x < np.inf)
+        bounds = sorted(set(bounds_list))
+        bounds.append(np.inf)
+
+    m.upper_bounds = np.asarray(bounds, dtype=np.float64)
+    num_value_bins = len(bounds)
+    if m.missing_type == MISSING_NAN:
+        m.num_bins = num_value_bins + 1
+        m.missing_bin = num_value_bins  # last bin holds NaN
+    else:
+        m.num_bins = num_value_bins
+    # zero/default bin (reference bin.h:138 GetDefaultBin)
+    m.default_bin = int(np.searchsorted(m.upper_bounds, 0.0, side="left"))
+    m.default_bin = min(m.default_bin, num_value_bins - 1)
+    if m.missing_type == MISSING_ZERO:
+        m.missing_bin = m.default_bin
+
+    # trivial feature: a single effective bin -> no split possible
+    m.is_trivial = m.num_bins <= 1 or (num_value_bins <= 1 and na_cnt == 0)
+    if min_split_data > 0 and not m.is_trivial:
+        # prune features that cannot satisfy min_data_in_leaf on any side
+        # (reference: feature_pre_filter via FindBin min_split_data arg)
+        counts = np.bincount(
+            np.clip(np.searchsorted(m.upper_bounds, vals, side="left"), 0, num_value_bins - 1),
+            minlength=num_value_bins,
+        )
+        counts[m.default_bin] += implicit_zero
+        csum = np.cumsum(counts)
+        ok = np.any((csum[:-1] >= min_split_data) & (csum[-1] - csum[:-1] >= min_split_data))
+        m.is_trivial = not bool(ok)
+
+    # most frequent bin on the sample
+    bins_sample = m.value_to_bin(np.concatenate([vals, np.full(implicit_zero, 0.0)]))
+    if len(bins_sample):
+        m.most_freq_bin = int(np.bincount(bins_sample, minlength=m.num_bins).argmax())
+    m.sparse_rate = zero_cnt / max(1, total_sample_cnt)
+    return m
+
+
+def _find_bin_categorical(
+    m: BinMapper,
+    vals: np.ndarray,
+    na_cnt: int,
+    zero_cnt: int,
+    max_bin: int,
+    min_data_in_bin: int,
+    total_sample_cnt: int,
+) -> BinMapper:
+    """Categorical dictionary: categories sorted by descending frequency, rare
+    categories cut (reference src/io/bin.cpp categorical branch: cut categories
+    after max_bin and warn on high cardinality; unseen/rare -> treated as the
+    'other' NaN bin)."""
+    ivals = vals.astype(np.int64)
+    if len(ivals) and ivals.min() < 0:
+        ivals = ivals[ivals >= 0]  # negative categories treated as missing
+        na_cnt += len(vals) - len(ivals)
+    cats, counts = (np.unique(ivals, return_counts=True) if len(ivals)
+                    else (np.array([], dtype=np.int64), np.array([], dtype=np.int64)))
+    order = np.argsort(-counts, kind="stable")
+    cats, counts = cats[order], counts[order]
+    # cut: keep top max_bin-1 (reserve one bin for other/missing)
+    keep = min(len(cats), max_bin - 1)
+    # also drop categories with very low count (reference keeps 99% mass)
+    if keep < len(cats):
+        cats, counts = cats[:keep], counts[:keep]
+    m.categories = cats
+    m.num_bins = len(cats) + 1  # +1 other/missing bin (last)
+    m.missing_bin = len(cats)
+    m.missing_type = MISSING_NAN
+    m.default_bin = 0
+    m.most_freq_bin = 0 if len(cats) else m.missing_bin
+    m.is_trivial = len(cats) <= 1
+    m.sparse_rate = zero_cnt / max(1, total_sample_cnt)
+    return m

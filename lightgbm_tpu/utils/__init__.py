@@ -1,0 +1,4 @@
+from .log import Log, LightGBMError, verbosity_to_level
+from .timer import Timer, global_timer
+
+__all__ = ["Log", "LightGBMError", "verbosity_to_level", "Timer", "global_timer"]

@@ -1,0 +1,95 @@
+"""Logging with levels and a redirectable sink.
+
+TPU-native equivalent of the reference's ``Log`` class
+(reference: include/LightGBM/utils/log.h:71) with Fatal/Warning/Info/Debug
+levels and a thread-local redirect callback (exposed in the reference as
+``LGBM_RegisterLogCallback`` / python ``register_logger``).
+"""
+from __future__ import annotations
+
+import sys
+import threading
+from typing import Callable, Optional
+
+
+class LightGBMError(RuntimeError):
+    """Error raised by the framework (reference: include/LightGBM/utils/log.h Fatal)."""
+
+
+_FATAL = -1
+_WARNING = 0
+_INFO = 1
+_DEBUG = 2
+
+_LEVEL_NAMES = {_FATAL: "Fatal", _WARNING: "Warning", _INFO: "Info", _DEBUG: "Debug"}
+
+_state = threading.local()
+
+
+def _get_level() -> int:
+    return getattr(_state, "level", _INFO)
+
+
+def _get_sink() -> Optional[Callable[[str], None]]:
+    return getattr(_state, "sink", None)
+
+
+class Log:
+    """Static-style logger mirroring the reference's API shape."""
+
+    FATAL = _FATAL
+    WARNING = _WARNING
+    INFO = _INFO
+    DEBUG = _DEBUG
+
+    @staticmethod
+    def reset_log_level(level: int) -> None:
+        _state.level = level
+
+    @staticmethod
+    def reset_callback(sink: Optional[Callable[[str], None]]) -> None:
+        _state.sink = sink
+
+    @staticmethod
+    def _write(level: int, msg: str) -> None:
+        if level > _get_level():
+            return
+        line = "[LightGBM-TPU] [%s] %s" % (_LEVEL_NAMES[level], msg)
+        sink = _get_sink()
+        if sink is not None:
+            sink(line + "\n")
+        else:
+            print(line, file=sys.stderr, flush=True)
+
+    @staticmethod
+    def debug(msg: str, *args) -> None:
+        Log._write(_DEBUG, msg % args if args else msg)
+
+    @staticmethod
+    def info(msg: str, *args) -> None:
+        Log._write(_INFO, msg % args if args else msg)
+
+    @staticmethod
+    def warning(msg: str, *args) -> None:
+        Log._write(_WARNING, msg % args if args else msg)
+
+    @staticmethod
+    def fatal(msg: str, *args) -> None:
+        text = msg % args if args else msg
+        Log._write(_FATAL, text)
+        raise LightGBMError(text)
+
+
+def verbosity_to_level(verbosity: int) -> int:
+    """Map the ``verbosity`` config parameter to a log level.
+
+    Mirrors the reference mapping (src/io/config.cpp:46-56): <0 fatal-only,
+    0 warning, 1 info, >1 debug.
+    """
+    if verbosity < 0:
+        return _FATAL
+    if verbosity == 0:
+        return _WARNING
+    if verbosity == 1:
+        return _INFO
+    return _DEBUG

@@ -1,0 +1,21 @@
+"""Test harness: run on a virtual 8-device CPU mesh.
+
+The reference tests multi-node behavior with in-process Dask workers
+(reference: tests/python_package_test/test_dask.py:26). Here the analog is
+8 virtual CPU devices via XLA host-platform device count; distributed tests
+build a jax.sharding.Mesh over them.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = _flags + " --xla_force_host_platform_device_count=8"
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(42)

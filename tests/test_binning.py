@@ -1,0 +1,104 @@
+"""BinMapper unit tests (reference behavior: src/io/bin.cpp FindBin)."""
+import numpy as np
+import pytest
+
+from lightgbm_tpu.ops.binning import (
+    BIN_CATEGORICAL,
+    MISSING_NAN,
+    MISSING_NONE,
+    MISSING_ZERO,
+    find_bin,
+)
+
+
+def test_distinct_values_get_own_bins():
+    vals = np.array([1.0, 2.0, 3.0] * 50)
+    m = find_bin(vals, len(vals), max_bin=255, min_data_in_bin=1)
+    assert not m.is_trivial
+    b = m.value_to_bin(np.array([1.0, 2.0, 3.0]))
+    assert len(set(b.tolist())) == 3
+    # ordering preserved
+    assert b[0] < b[1] < b[2]
+
+def test_monotone_binning():
+    rng = np.random.RandomState(0)
+    vals = rng.normal(size=10000)
+    m = find_bin(vals, len(vals), max_bin=63, min_data_in_bin=3)
+    assert m.num_bins <= 63
+    x = np.sort(rng.normal(size=100))
+    b = m.value_to_bin(x)
+    assert np.all(np.diff(b) >= 0)
+
+def test_equal_density():
+    rng = np.random.RandomState(1)
+    vals = rng.uniform(1.0, 2.0, size=100000)  # all positive, no zeros
+    m = find_bin(vals, len(vals), max_bin=100, min_data_in_bin=1)
+    b = m.value_to_bin(vals)
+    counts = np.bincount(b, minlength=m.num_bins)
+    nonzero = counts[counts > 0]
+    # equal-density: bin populations within ~4x of each other
+    assert nonzero.max() < 6 * max(1, nonzero.mean())
+
+def test_zero_bin():
+    vals = np.concatenate([np.zeros(500), np.random.RandomState(2).normal(size=500)])
+    m = find_bin(vals, len(vals), max_bin=32, min_data_in_bin=1)
+    zb = m.value_to_bin(np.array([0.0]))[0]
+    assert zb == m.default_bin
+    # most frequent bin is the zero bin here
+    assert m.most_freq_bin == zb
+
+def test_nan_missing():
+    vals = np.concatenate([np.random.RandomState(3).normal(size=900), np.full(100, np.nan)])
+    m = find_bin(vals, len(vals), max_bin=16, min_data_in_bin=1, use_missing=True)
+    assert m.missing_type == MISSING_NAN
+    nb = m.value_to_bin(np.array([np.nan]))[0]
+    assert nb == m.missing_bin == m.num_bins - 1
+
+def test_no_missing_handling():
+    vals = np.concatenate([np.random.RandomState(3).normal(size=900), np.full(100, np.nan)])
+    m = find_bin(vals, len(vals), max_bin=16, min_data_in_bin=1, use_missing=False)
+    assert m.missing_type == MISSING_NONE
+    # NaN maps like zero
+    assert m.value_to_bin(np.array([np.nan]))[0] == m.value_to_bin(np.array([0.0]))[0]
+
+def test_zero_as_missing():
+    vals = np.concatenate([np.zeros(500), np.random.RandomState(4).normal(size=500)])
+    m = find_bin(vals, len(vals), max_bin=16, min_data_in_bin=1, zero_as_missing=True)
+    assert m.missing_type == MISSING_ZERO
+    assert m.value_to_bin(np.array([np.nan]))[0] == m.value_to_bin(np.array([0.0]))[0] == m.missing_bin
+
+def test_trivial_feature():
+    m = find_bin(np.full(100, 7.0), 100, max_bin=255, min_data_in_bin=1)
+    assert m.is_trivial
+
+def test_categorical():
+    rng = np.random.RandomState(5)
+    vals = rng.choice([3, 7, 11, 500], p=[0.5, 0.3, 0.15, 0.05], size=1000).astype(float)
+    m = find_bin(vals, len(vals), max_bin=255, min_data_in_bin=1, bin_type=BIN_CATEGORICAL)
+    assert m.bin_type == BIN_CATEGORICAL
+    assert m.num_bins == 5  # 4 cats + other
+    b = m.value_to_bin(np.array([3.0, 7.0, 11.0, 500.0, 999.0, np.nan]))
+    assert b[0] == 0  # most frequent first
+    assert b[4] == m.missing_bin and b[5] == m.missing_bin
+    # round trip
+    assert int(m.categories[b[1]]) == 7
+
+def test_categorical_cut_to_max_bin():
+    rng = np.random.RandomState(6)
+    vals = rng.randint(0, 100, size=5000).astype(float)
+    m = find_bin(vals, len(vals), max_bin=16, min_data_in_bin=1, bin_type=BIN_CATEGORICAL)
+    assert m.num_bins <= 16
+
+def test_max_bin_respected():
+    rng = np.random.RandomState(7)
+    vals = rng.normal(size=100000)
+    for mb in (16, 64, 255):
+        m = find_bin(vals, len(vals), max_bin=mb, min_data_in_bin=3)
+        assert m.num_bins <= mb
+
+def test_zero_as_missing_all_positive_reserves_zero_bin():
+    # regression: zeros must not share a bin with the smallest real values
+    vals = np.concatenate([np.zeros(500), np.random.RandomState(9).uniform(1, 2, 500)])
+    m = find_bin(vals, len(vals), max_bin=16, min_data_in_bin=1, zero_as_missing=True)
+    assert m.value_to_bin(np.array([0.0]))[0] != m.value_to_bin(np.array([1.01]))[0]
+    assert m.sparse_rate == 0.5

@@ -1,0 +1,61 @@
+"""Config registry tests (reference behavior: src/io/config.cpp Config::Set)."""
+import pytest
+
+from lightgbm_tpu.config import Config, resolve_aliases
+from lightgbm_tpu.utils.log import LightGBMError
+
+
+def test_defaults():
+    c = Config()
+    assert c.num_leaves == 31
+    assert c.learning_rate == 0.1
+    assert c.max_bin == 255
+    assert c.objective == "regression"
+
+def test_aliases():
+    c = Config.from_params({"n_estimators": 50, "eta": 0.3, "min_child_samples": 5,
+                            "reg_alpha": 1.0, "reg_lambda": 2.0, "subsample": 0.8,
+                            "colsample_bytree": 0.7, "num_leaf": 15})
+    assert c.num_iterations == 50
+    assert c.learning_rate == 0.3
+    assert c.min_data_in_leaf == 5
+    assert c.lambda_l1 == 1.0
+    assert c.lambda_l2 == 2.0
+    assert c.bagging_fraction == 0.8
+    assert c.feature_fraction == 0.7
+    assert c.num_leaves == 15
+
+def test_canonical_wins_over_alias():
+    r = resolve_aliases({"num_iterations": 10, "n_estimators": 99})
+    assert r["num_iterations"] == 10
+
+def test_string_coercion():
+    c = Config.from_params({"num_leaves": "63", "learning_rate": "0.05",
+                            "boost_from_average": "false", "metric": "l2,l1"})
+    assert c.num_leaves == 63
+    assert c.learning_rate == 0.05
+    assert c.boost_from_average is False
+    assert c.metric == ["l2", "l1"]
+
+def test_goss_boosting_normalized():
+    c = Config.from_params({"boosting": "goss"})
+    assert c.boosting == "gbdt"
+    assert c.data_sample_strategy == "goss"
+
+def test_invalid_params_raise():
+    with pytest.raises(LightGBMError):
+        Config.from_params({"num_leaves": 1})
+    with pytest.raises(LightGBMError):
+        Config.from_params({"bagging_fraction": 0.0})
+
+def test_multiclass_requires_num_class():
+    with pytest.raises(LightGBMError):
+        Config.from_params({"objective": "multiclass"})
+    c = Config.from_params({"objective": "multiclass", "num_class": 3})
+    assert c.num_class == 3
+
+def test_constructor_validates_and_normalizes():
+    c = Config(boosting="goss")
+    assert c.boosting == "gbdt" and c.data_sample_strategy == "goss"
+    with pytest.raises(LightGBMError):
+        Config(num_leaves=1)

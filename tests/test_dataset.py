@@ -1,0 +1,78 @@
+"""BinnedDataset construction tests (reference: src/io/dataset.cpp Construct)."""
+import numpy as np
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.dataset import Metadata, construct_dataset
+
+
+def _make_X(n=1000, f=10, seed=0):
+    rng = np.random.RandomState(seed)
+    return rng.normal(size=(n, f))
+
+
+def test_basic_construction():
+    X = _make_X()
+    y = np.random.RandomState(1).normal(size=1000)
+    ds = construct_dataset(X, Config(), label=y)
+    assert ds.num_data == 1000
+    assert ds.num_features == 10
+    assert ds.binned.shape == (1000, 10)
+    assert ds.binned.dtype == np.uint8
+    assert ds.metadata.label is not None and len(ds.metadata.label) == 1000
+
+def test_trivial_feature_dropped():
+    X = _make_X()
+    X[:, 3] = 5.0  # constant
+    ds = construct_dataset(X, Config(), label=np.zeros(1000))
+    assert ds.num_features == 9
+    assert 3 not in ds.used_feature_indices
+
+def test_reference_binning_reused():
+    X = _make_X()
+    ds = construct_dataset(X, Config(), label=np.zeros(1000))
+    X2 = _make_X(seed=5)
+    ds2 = construct_dataset(X2, Config(), reference=ds)
+    assert ds2.bin_mappers is ds.bin_mappers
+    # same value -> same bin under both datasets
+    v = X[0:1, :]
+    b1 = [m.value_to_bin(v[:, i])[0] for i, m in zip(range(10), ds.bin_mappers)]
+    b2 = [m.value_to_bin(v[:, i])[0] for i, m in zip(range(10), ds2.bin_mappers)]
+    assert b1 == b2
+
+def test_group_metadata_sizes():
+    md = Metadata(10, group=np.array([4, 3, 3]))
+    assert md.num_queries == 3
+    assert md.query_boundaries.tolist() == [0, 4, 7, 10]
+    assert md.query_id.tolist() == [0]*4 + [1]*3 + [2]*3
+
+def test_group_metadata_per_row_ids():
+    md = Metadata(6, group=np.array([7, 7, 7, 9, 9, 9]))
+    assert md.num_queries == 2
+    assert md.query_boundaries.tolist() == [0, 3, 6]
+
+def test_categorical_feature():
+    rng = np.random.RandomState(2)
+    X = _make_X()
+    X[:, 0] = rng.randint(0, 5, size=1000)
+    ds = construct_dataset(X, Config(), label=np.zeros(1000), categorical_feature=[0])
+    from lightgbm_tpu.ops.binning import BIN_CATEGORICAL
+    assert ds.bin_mappers[0].bin_type == BIN_CATEGORICAL
+
+def test_uint16_for_large_max_bin():
+    rng = np.random.RandomState(3)
+    X = rng.normal(size=(5000, 2))
+    ds = construct_dataset(X, Config.from_params({"max_bin": 1000, "min_data_in_bin": 1}),
+                           label=np.zeros(5000))
+    assert ds.binned.dtype == np.uint16
+    assert ds.max_bins_per_feature > 256
+
+def test_group_sizes_vector_of_ones():
+    # regression: [1,1,1] is a sizes vector (3 singleton queries), not qids
+    md = Metadata(3, group=np.array([1, 1, 1]))
+    assert md.num_queries == 3
+
+def test_non_contiguous_qids_rejected():
+    import pytest
+    from lightgbm_tpu.utils.log import LightGBMError
+    with pytest.raises(LightGBMError):
+        Metadata(6, group=np.array([7, 9, 7, 9, 7, 9]))
