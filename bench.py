@@ -1,0 +1,74 @@
+"""Benchmark: HIGGS-like binary classification training throughput.
+
+Mirrors the reference's headline benchmark shape (docs/Experiments.rst:109 —
+HIGGS 28 dense numerical features, binary objective, 500 iterations) at a
+size that fits a single-chip round: the metric is training throughput in
+M rows·iterations / second, compared against the reference CPU baseline's
+published throughput on the same workload class
+(130.094 s for 500 iters × 10.5M rows = 40.4 M row·iter/s, BASELINE.md).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+N_ROWS = int(os.environ.get("BENCH_ROWS", 500_000))
+N_FEAT = 28
+N_ITER = int(os.environ.get("BENCH_ITERS", 100))
+NUM_LEAVES = int(os.environ.get("BENCH_LEAVES", 31))
+MAX_BIN = int(os.environ.get("BENCH_MAX_BIN", 63))
+
+# reference CPU Higgs: 130.094 s / (500 iter * 10.5M rows)  [BASELINE.md]
+BASELINE_ROWS_ITER_PER_SEC = (500 * 10.5e6) / 130.094
+
+
+def make_higgs_like(n, f, seed=7):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f).astype(np.float32)
+    w = rng.randn(f) / np.sqrt(f)
+    logit = X @ w + 0.5 * np.sin(X[:, 0] * 2) * X[:, 1] + 0.3 * rng.randn(n)
+    y = (logit > 0).astype(np.float64)
+    return X.astype(np.float64), y
+
+
+def main():
+    import lightgbm_tpu as lgb
+
+    X, y = make_higgs_like(N_ROWS, N_FEAT)
+    params = {
+        "objective": "binary",
+        "num_leaves": NUM_LEAVES,
+        "max_bin": MAX_BIN,
+        "learning_rate": 0.1,
+        "verbosity": -1,
+        "metric": ["auc"],
+    }
+    ds = lgb.Dataset(X, label=y)
+    # warmup: bins + compiles (first compile is excluded, like the reference's
+    # timings which exclude data loading)
+    t0 = time.time()
+    warm = lgb.train(dict(params), ds, num_boost_round=2)
+    warmup_s = time.time() - t0
+
+    t0 = time.time()
+    bst = lgb.train(dict(params), ds, num_boost_round=N_ITER)
+    train_s = time.time() - t0
+
+    (_, _, auc, _), = bst.eval_train()
+    rows_iter_per_sec = (N_ROWS * N_ITER) / train_s
+    result = {
+        "metric": "higgs_like_binary_train_throughput",
+        "value": round(rows_iter_per_sec / 1e6, 4),
+        "unit": "M rows*iters/s (N=%d F=%d leaves=%d bins=%d iters=%d; auc=%.4f; train=%.1fs warmup=%.1fs)"
+                % (N_ROWS, N_FEAT, NUM_LEAVES, MAX_BIN, N_ITER, auc, train_s, warmup_s),
+        "vs_baseline": round(rows_iter_per_sec / BASELINE_ROWS_ITER_PER_SEC, 4),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,323 @@
+"""User-facing Dataset and Booster.
+
+Equivalent of the reference python package's ctypes layer
+(reference: python-package/lightgbm/basic.py:1035 Dataset, :2142 Booster) —
+except there is no C ABI to cross: the "native" side here is the jitted
+JAX/XLA program, so Dataset wraps BinnedDataset construction lazily and
+Booster wraps the boosting driver directly.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .config import Config, resolve_aliases
+from .dataset import BinnedDataset, construct_dataset
+from .boosting import GBDT, create_boosting
+from .utils.log import Log, LightGBMError
+
+
+def _to_2d(data) -> np.ndarray:
+    if hasattr(data, "toarray"):  # scipy sparse
+        data = data.toarray()
+    if hasattr(data, "values") and not isinstance(data, np.ndarray):  # pandas
+        data = data.values
+    arr = np.asarray(data, dtype=np.float64)
+    if arr.ndim == 1:
+        arr = arr.reshape(-1, 1)
+    return arr
+
+
+def _to_1d(data) -> Optional[np.ndarray]:
+    if data is None:
+        return None
+    if hasattr(data, "values") and not isinstance(data, np.ndarray):
+        data = data.values
+    return np.asarray(data).ravel()
+
+
+class Dataset:
+    """Lazily-constructed training dataset (reference: basic.py:1035).
+    Binning happens at ``construct()`` (inside ``train``), so parameters set
+    afterwards still apply — mirroring the reference's lazy ``_lazy_init``."""
+
+    def __init__(self, data, label=None, *, reference: Optional["Dataset"] = None,
+                 weight=None, group=None, init_score=None,
+                 feature_name: Union[str, List[str]] = "auto",
+                 categorical_feature: Union[str, List] = "auto",
+                 params: Optional[Dict[str, Any]] = None,
+                 free_raw_data: bool = False) -> None:
+        self.data = data
+        self.label = _to_1d(label)
+        self.weight = _to_1d(weight)
+        self.group = _to_1d(group)
+        self.init_score = None if init_score is None else np.asarray(init_score)
+        self.reference = reference
+        self.feature_name = feature_name
+        self.categorical_feature = categorical_feature
+        self.params = dict(params) if params else {}
+        self.free_raw_data = free_raw_data
+        self._constructed: Optional[BinnedDataset] = None
+        self._used_params: Optional[Dict[str, Any]] = None
+
+    # -- setters mirroring the reference API --
+    def set_label(self, label) -> "Dataset":
+        self.label = _to_1d(label)
+        if self._constructed is not None:
+            self._constructed.metadata.label = np.ascontiguousarray(
+                self.label, dtype=np.float32)
+        return self
+
+    def set_weight(self, weight) -> "Dataset":
+        self.weight = _to_1d(weight)
+        if self._constructed is not None:
+            self._constructed.metadata.weight = None if weight is None else \
+                np.ascontiguousarray(self.weight, dtype=np.float32)
+        return self
+
+    def set_group(self, group) -> "Dataset":
+        self.group = _to_1d(group)
+        self._constructed = None
+        return self
+
+    def set_init_score(self, init_score) -> "Dataset":
+        self.init_score = None if init_score is None else np.asarray(init_score)
+        self._constructed = None
+        return self
+
+    def get_label(self):
+        return self.label
+
+    def get_weight(self):
+        return self.weight
+
+    def get_group(self):
+        if self._constructed is not None and \
+                self._constructed.metadata.query_boundaries is not None:
+            return np.diff(self._constructed.metadata.query_boundaries)
+        return self.group
+
+    def get_init_score(self):
+        return self.init_score
+
+    def num_data(self) -> int:
+        if self._constructed is not None:
+            return self._constructed.num_data
+        return _to_2d(self.data).shape[0]
+
+    def num_feature(self) -> int:
+        if self._constructed is not None:
+            return self._constructed.num_total_features
+        return _to_2d(self.data).shape[1]
+
+    def construct(self, params: Optional[Dict[str, Any]] = None) -> BinnedDataset:
+        merged = dict(self.params)
+        if params:
+            merged.update(params)
+        if self._constructed is not None and self._used_params == merged:
+            return self._constructed
+        cfg = Config.from_params(merged)
+        X = _to_2d(self.data)
+        feature_names = None
+        if isinstance(self.feature_name, (list, tuple)):
+            feature_names = list(self.feature_name)
+        elif hasattr(self.data, "columns"):
+            feature_names = [str(c) for c in self.data.columns]
+        cat = self.categorical_feature
+        if cat == "auto":
+            cat = None
+        ref_binned = self.reference.construct(params) if self.reference else None
+        self._constructed = construct_dataset(
+            X, cfg, label=self.label, weight=self.weight, group=self.group,
+            init_score=self.init_score, feature_names=feature_names,
+            categorical_feature=cat, reference=ref_binned)
+        self._used_params = merged
+        if self.free_raw_data:
+            self.data = None
+        return self._constructed
+
+    def create_valid(self, data, label=None, weight=None, group=None,
+                     init_score=None, params=None) -> "Dataset":
+        return Dataset(data, label=label, reference=self, weight=weight,
+                       group=group, init_score=init_score, params=params)
+
+    def save_binary(self, filename: str) -> "Dataset":
+        """Cache the binned dataset (reference: Dataset::SaveBinaryFile,
+        dataset.h:441) — numpy npz instead of a custom binary layout."""
+        ds = self.construct()
+        np.savez_compressed(
+            filename,
+            binned=ds.binned,
+            label=ds.metadata.label if ds.metadata.label is not None else np.array([]),
+            used=np.asarray(ds.used_feature_indices),
+        )
+        return self
+
+
+class Booster:
+    """Training-capable model handle (reference: basic.py:2142)."""
+
+    def __init__(self, params: Optional[Dict[str, Any]] = None,
+                 train_set: Optional[Dataset] = None,
+                 model_file: Optional[str] = None,
+                 model_str: Optional[str] = None,
+                 comm_axis: Optional[str] = None) -> None:
+        params = params or {}
+        self.params = params
+        self.train_dataset = train_set
+        self._valid_names: List[str] = []
+        if train_set is not None:
+            if not isinstance(train_set, Dataset):
+                raise TypeError("train_set must be a Dataset")
+            binned = train_set.construct(params)
+            self.config = Config.from_params(params)
+            self.inner: GBDT = create_boosting(self.config, binned, comm_axis)
+        elif model_file is not None:
+            with open(model_file) as f:
+                self.inner = GBDT.model_from_string(f.read())
+            self.config = self.inner.config
+        elif model_str is not None:
+            self.inner = GBDT.model_from_string(model_str)
+            self.config = self.inner.config
+        else:
+            raise LightGBMError("Need train_set, model_file or model_str")
+        self.best_iteration = -1
+        self.best_score: Dict[str, Dict[str, float]] = {}
+
+    def add_valid(self, data: Dataset, name: str) -> "Booster":
+        if data.reference is None:
+            data.reference = self.train_dataset
+        binned = data.construct(self.params)
+        self.inner.add_valid(name, binned)
+        self._valid_names.append(name)
+        return self
+
+    def update(self, train_set: Optional[Dataset] = None, fobj=None) -> bool:
+        """One boosting iteration; returns True if stopped
+        (reference: basic.py:2565 update / __boost)."""
+        if train_set is not None:
+            raise LightGBMError("Resetting train_set is not supported yet")
+        if fobj is not None:
+            grad, hess = fobj(np.asarray(self.inner.train_score.score),
+                              self.train_dataset)
+            return self.inner.train_one_iter(np.asarray(grad), np.asarray(hess))
+        return self.inner.train_one_iter()
+
+    def rollback_one_iter(self) -> "Booster":
+        self.inner.rollback_one_iter()
+        return self
+
+    @property
+    def current_iteration(self) -> int:
+        return self.inner.current_iteration
+
+    def num_trees(self) -> int:
+        return self.inner.num_trees()
+
+    def num_model_per_iteration(self) -> int:
+        return self.inner.num_tree_per_iteration
+
+    def eval_train(self, feval=None):
+        return self.inner.eval_train(feval)
+
+    def eval_valid(self, feval=None):
+        return self.inner.eval_valid(feval)
+
+    def predict(self, data, *, raw_score: bool = False, start_iteration: int = 0,
+                num_iteration: Optional[int] = None, pred_leaf: bool = False,
+                pred_contrib: bool = False, **kwargs) -> np.ndarray:
+        X = _to_2d(data)
+        if num_iteration is None:
+            # early stopping: default to the best iteration like the
+            # reference python package (basic.py Booster.predict)
+            num_iteration = self.best_iteration if self.best_iteration > 0 else -1
+        if pred_contrib:
+            return self._predict_contrib(X, num_iteration)
+        ni = num_iteration
+        return self.inner.predict(X, raw_score=raw_score,
+                                  start_iteration=start_iteration,
+                                  num_iteration=ni, pred_leaf=pred_leaf)
+
+    def _predict_contrib(self, X: np.ndarray, num_iteration) -> np.ndarray:
+        """SHAP-style contributions via path-attribution on each tree
+        (reference: TreeSHAP in src/io/tree.cpp). Round-1 implementation:
+        exact SHAP for each tree computed on host."""
+        from .shap import tree_shap_contribs
+        return tree_shap_contribs(self.inner, X, num_iteration)
+
+    def save_model(self, filename: str, num_iteration: Optional[int] = None,
+                   start_iteration: int = 0) -> "Booster":
+        ni = -1 if num_iteration is None else num_iteration
+        self.inner.best_iteration = self.best_iteration
+        self.inner.save_model(filename, ni)
+        return self
+
+    def model_to_string(self, num_iteration: Optional[int] = None) -> str:
+        ni = -1 if num_iteration is None else num_iteration
+        return self.inner.model_to_string(ni)
+
+    def dump_model(self, num_iteration: Optional[int] = None) -> Dict[str, Any]:
+        import json
+        ni = -1 if num_iteration is None else num_iteration
+        return json.loads(self.inner.dump_json(ni))
+
+    def feature_importance(self, importance_type: str = "split",
+                           iteration: Optional[int] = None) -> np.ndarray:
+        it = -1 if iteration is None else iteration
+        return self.inner.feature_importance(importance_type, it)
+
+    def feature_name(self) -> List[str]:
+        if self.inner.train_set is not None:
+            return self.inner.train_set.feature_names
+        return getattr(self.inner, "_feature_names", [])
+
+    def reset_parameter(self, params: Dict[str, Any]) -> "Booster":
+        """(reference: Booster::ResetConfig path, gbdt.cpp:684)"""
+        self.params.update(params)
+        self.config.set(params)
+        # refresh learner hyperparameters that affect future trees
+        if self.inner.learner is not None:
+            from .learner import SerialTreeLearner
+            self.inner.learner = SerialTreeLearner(
+                self.config, self.inner.train_set, self.inner.comm_axis)
+        return self
+
+    def refit(self, data, label, decay_rate: Optional[float] = None, **kwargs):
+        """Refit leaf values on new data (reference: GBDT::RefitTree,
+        gbdt.cpp:285; python Booster.refit)."""
+        decay = self.config.refit_decay_rate if decay_rate is None else decay_rate
+        X = _to_2d(data)
+        y = _to_1d(label)
+        new_booster = Booster(model_str=self.model_to_string())
+        K = new_booster.inner.num_tree_per_iteration
+        score = np.zeros((X.shape[0], K))
+        score += new_booster.inner.init_scores[None, :K]
+        for i, tree in enumerate(new_booster.inner.models):
+            leaf_idx = tree.predict_leaf_index(X)
+            # grad at current score for this class
+            import jax.numpy as jnp
+            obj = new_booster.inner.objective
+            obj.init(type("M", (), {
+                "num_data": len(y),
+                "label": np.asarray(y, np.float32),
+                "weight": None, "init_score": None, "query_boundaries": None})())
+            s = jnp.asarray(score if K > 1 else score.ravel(), jnp.float32)
+            g, h = obj.get_gradients(s)
+            g = np.asarray(g).reshape(len(y), -1)[:, i % K]
+            h = np.asarray(h).reshape(len(y), -1)[:, i % K]
+            lam = new_booster.config.lambda_l2
+            for l in range(tree.num_leaves):
+                m = leaf_idx == l
+                if np.any(m):
+                    new_val = -g[m].sum() / (h[m].sum() + lam)
+                    tree.leaf_value[l] = decay * tree.leaf_value[l] + \
+                        (1 - decay) * new_val * tree.shrinkage
+            score[:, i % K] += tree.predict(X)
+        return new_booster
+
+
+def register_logger(logger) -> None:
+    """Redirect framework logging to a python logging.Logger
+    (reference: basic.py register_logger)."""
+    Log.reset_callback(lambda msg: logger.info(msg.rstrip("\n")))

@@ -1,0 +1,689 @@
+"""Boosting drivers: GBDT / DART / GOSS sampling / RF.
+
+TPU-native equivalent of the reference boosting layer (reference:
+src/boosting/gbdt.cpp GBDT::Train/TrainOneIter, goss.hpp, dart.hpp, rf.hpp,
+score_updater.hpp). The training loop stays on host (it is O(iterations),
+not O(rows)); all O(rows) work — gradients, histograms, score updates,
+prediction routing — is jitted device code. Scores are float32 device arrays
+(the reference keeps double; the f32 choice follows its GPU precedent).
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .config import Config
+from .dataset import BinnedDataset
+from .learner import SerialTreeLearner, TreeLog, assign_leaves
+from .metric import Metric, create_metrics
+from .objective import ObjectiveFunction, create_objective
+from .tree import Tree
+from .utils.log import Log
+
+
+class ScoreTracker:
+    """Running raw scores for one dataset (reference: score_updater.hpp:21)."""
+
+    def __init__(self, num_data: int, num_class: int, init: np.ndarray) -> None:
+        shape = (num_data, num_class) if num_class > 1 else (num_data,)
+        s = np.zeros(shape, dtype=np.float32)
+        s += init if num_class > 1 else init[0]
+        self.score = jnp.asarray(s)
+
+    def add(self, leaf_values: np.ndarray, leaf_assign: jax.Array, class_id: int,
+            num_class: int) -> None:
+        vals = jnp.asarray(leaf_values, jnp.float32)[leaf_assign]
+        if num_class > 1:
+            self.score = self.score.at[:, class_id].add(vals)
+        else:
+            self.score = self.score + vals
+
+    def np(self) -> np.ndarray:
+        return np.asarray(self.score)
+
+
+class GBDT:
+    """Gradient Boosting (reference: src/boosting/gbdt.cpp:264 Train,
+    :369 TrainOneIter)."""
+
+    name = "gbdt"
+
+    def __init__(self, config: Config, train_set: Optional[BinnedDataset],
+                 comm_axis: Optional[str] = None) -> None:
+        self.config = config
+        self.train_set = train_set
+        self.models: List[Tree] = []
+        self.iter_ = 0
+        self.num_class = max(1, int(config.num_class))
+        self.objective: Optional[ObjectiveFunction] = None
+        self.metrics: List[Metric] = []
+        self.init_scores = np.zeros(self.num_class, dtype=np.float64)
+        self.valid_sets: List[Tuple[str, BinnedDataset, ScoreTracker]] = []
+        self.learner: Optional[SerialTreeLearner] = None
+        self.train_score: Optional[ScoreTracker] = None
+        self._rng = np.random.RandomState(
+            config.seed if config.seed is not None else config.data_random_seed)
+        self._key = jax.random.PRNGKey(
+            config.seed if config.seed is not None else 0)
+        self._inbag: Optional[jax.Array] = None  # (N,) f32 0/1
+        self._grad_fn = None
+        self.best_iteration = -1
+        self.comm_axis = comm_axis
+        if train_set is not None:
+            self._setup(train_set)
+
+    # ------------------------------------------------------------------ setup
+    def _setup(self, train_set: BinnedDataset) -> None:
+        cfg = self.config
+        self.objective = create_objective(cfg)
+        self.objective.init(train_set.metadata)
+        self.num_tree_per_iteration = self.objective.num_model_per_iteration
+        self.metrics = create_metrics(cfg, self.objective.name)
+        from .parallel.mesh import create_tree_learner, make_mesh
+        mesh = None
+        if cfg.tree_learner != "serial":
+            import jax as _jax
+            if len(_jax.devices()) > 1:
+                mesh = make_mesh()
+        self.learner = create_tree_learner(cfg, train_set, mesh)
+        n = train_set.num_data
+        # boost_from_average (reference: gbdt.cpp:333; distributed mean is a
+        # psum at objective level — labels are row-sharded the same way)
+        if cfg.boost_from_average and self.objective.name != "none" \
+                and train_set.metadata.label is not None:
+            for k in range(self.num_tree_per_iteration):
+                self.init_scores[k] = self.objective.boost_from_score(k)
+        if train_set.metadata.init_score is not None:
+            base = train_set.metadata.init_score.reshape(
+                n, -1) if self.num_class > 1 else train_set.metadata.init_score.ravel()
+        else:
+            base = None
+        self.train_score = ScoreTracker(
+            n, self.num_tree_per_iteration, self.init_scores)
+        if base is not None:
+            self.train_score.score = self.train_score.score + jnp.asarray(
+                base, jnp.float32)
+        self._inbag = jnp.ones((n,), jnp.float32)
+        self._setup_grad_fn()
+
+    def _setup_grad_fn(self) -> None:
+        obj = self.objective
+
+        @jax.jit
+        def grads(score):
+            return obj.get_gradients(score)
+
+        self._grad_fn = grads
+
+    def add_valid(self, name: str, valid_set: BinnedDataset) -> None:
+        vs = ScoreTracker(valid_set.num_data, self.num_tree_per_iteration,
+                          self.init_scores)
+        if valid_set.metadata.init_score is not None:
+            base = valid_set.metadata.init_score
+            base = base.reshape(valid_set.num_data, -1) if self.num_class > 1 \
+                else base.ravel()
+            vs.score = vs.score + jnp.asarray(base, jnp.float32)
+        # replay already-trained trees (continued training)
+        if self.models:
+            bins = jnp.asarray(valid_set.binned)
+            Log.debug("Replaying %d trees onto valid set %s", len(self.models), name)
+            for i, tree in enumerate(self.models):
+                leaf = self._route_tree_host(tree, valid_set)
+                vs.add(tree.leaf_value, jnp.asarray(leaf), i % self.num_tree_per_iteration,
+                       self.num_tree_per_iteration)
+        self.valid_sets.append((name, valid_set, vs))
+
+    def _route_tree_host(self, tree: Tree, ds: BinnedDataset) -> np.ndarray:
+        # route binned rows through a host Tree via bin tables
+        # (rarely used: only for continued-training valid replay)
+        raise_if = tree.num_leaves
+        del raise_if
+        # fall back to raw-value prediction is not possible (no raw data kept);
+        # use bin-threshold routing
+        n = ds.num_data
+        node = np.zeros(n, dtype=np.int64)
+        binned = ds.binned
+        active = node >= 0
+        from .ops.binning import BIN_CATEGORICAL
+        while np.any(active):
+            for nd in np.unique(node[active]):
+                sel = active & (node == nd)
+                real_f = tree.split_feature[nd]
+                inner = ds.inner_feature_index(int(real_f))
+                mapper = ds.bin_mappers[inner]
+                bvals = binned[sel, inner].astype(np.int64)
+                if tree.decision_type[nd] & 1:
+                    cats = tree.cat_threshold.get(int(nd), np.array([], dtype=np.int64))
+                    cat_of_bin = np.full(mapper.num_bins, -1, dtype=np.int64)
+                    for b in range(len(mapper.categories)):
+                        cat_of_bin[b] = mapper.categories[b]
+                    go_left = np.isin(cat_of_bin[bvals], cats)
+                else:
+                    thr_bin = int(tree.split_bin[nd]) if hasattr(tree, "split_bin") else 0
+                    go_left = bvals <= thr_bin
+                    if mapper.missing_type == 2:
+                        dl = bool(tree.decision_type[nd] & 2)
+                        go_left = np.where(bvals == mapper.missing_bin, dl, go_left)
+                node[sel] = np.where(go_left, tree.left_child[nd], tree.right_child[nd])
+            active = node >= 0
+        return (~node).astype(np.int32)
+
+    # --------------------------------------------------------------- sampling
+    def _bagging(self, it: int, grad: jax.Array, hess: jax.Array) -> None:
+        """Refresh the in-bag mask (reference: gbdt.cpp:228 Bagging,
+        goss.hpp:103 for data_sample_strategy=goss)."""
+        cfg = self.config
+        n = self.train_set.num_data
+        if cfg.data_sample_strategy == "goss":
+            warmup = int(1.0 / max(cfg.learning_rate, 1e-12))
+            if it < warmup or cfg.top_rate + cfg.other_rate >= 1.0:
+                self._inbag = jnp.ones((n,), jnp.float32)
+                self._amp = None
+                return
+            g = grad if grad.ndim == 1 else jnp.sum(jnp.abs(grad), axis=1)
+            h = hess if hess.ndim == 1 else jnp.sum(jnp.abs(hess), axis=1)
+            s = jnp.abs(g * h)
+            top_k = max(1, int(n * cfg.top_rate))
+            thr = jnp.sort(s)[n - top_k]
+            is_top = s >= thr
+            key = jax.random.fold_in(self._key, 7000 + it)
+            rest_rate = cfg.other_rate / max(1e-12, 1.0 - cfg.top_rate)
+            sampled = (jax.random.uniform(key, (n,)) < rest_rate) & ~is_top
+            amp = (1.0 - cfg.top_rate) / max(cfg.other_rate, 1e-12)
+            self._inbag = (is_top | sampled).astype(jnp.float32)
+            self._amp = jnp.where(sampled, amp, 1.0).astype(jnp.float32)
+            return
+        self._amp = None
+        need = cfg.bagging_freq > 0 and (
+            cfg.bagging_fraction < 1.0
+            or cfg.pos_bagging_fraction < 1.0 or cfg.neg_bagging_fraction < 1.0)
+        if not need:
+            return
+        if it % cfg.bagging_freq != 0 and self._inbag is not None and it > 0:
+            return
+        rng = np.random.RandomState(cfg.bagging_seed + it)
+        lab = self.train_set.metadata.label
+        if (cfg.pos_bagging_fraction < 1.0 or cfg.neg_bagging_fraction < 1.0) \
+                and lab is not None:
+            # balanced bagging (reference: gbdt.cpp:199 BalancedBaggingHelper)
+            mask = np.zeros(n, dtype=np.float32)
+            pos = lab > 0
+            mask[pos] = rng.rand(int(pos.sum())) < cfg.pos_bagging_fraction
+            mask[~pos] = rng.rand(int((~pos).sum())) < cfg.neg_bagging_fraction
+        else:
+            mask = (rng.rand(n) < cfg.bagging_fraction).astype(np.float32)
+        self._inbag = jnp.asarray(mask, jnp.float32)
+
+    def _tree_channels(self, grad: jax.Array, hess: jax.Array, k: int) -> jax.Array:
+        g = grad if grad.ndim == 1 else grad[:, k]
+        h = hess if hess.ndim == 1 else hess[:, k]
+        if getattr(self, "_amp", None) is not None:
+            g, h = g * self._amp, h * self._amp
+        m = self._inbag
+        return jnp.stack([g * m, h * m, m], axis=1)
+
+    def _feature_mask(self, it: int) -> jax.Array:
+        cfg = self.config
+        nf = self.train_set.num_features
+        mask = np.ones(nf, dtype=bool)
+        if cfg.feature_fraction < 1.0:
+            k = max(1, int(np.ceil(cfg.feature_fraction * nf)))
+            rng = np.random.RandomState(cfg.feature_fraction_seed + it)
+            chosen = rng.choice(nf, size=k, replace=False)
+            mask = np.zeros(nf, dtype=bool)
+            mask[chosen] = True
+        return jnp.asarray(mask)
+
+    # --------------------------------------------------------------- training
+    def train_one_iter(self, grad: Optional[np.ndarray] = None,
+                       hess: Optional[np.ndarray] = None) -> bool:
+        """One boosting iteration (reference: gbdt.cpp:369 TrainOneIter).
+        Returns True when no tree could be grown (all-stop signal)."""
+        it = self.iter_
+        if grad is None:
+            g, h = self._grad_fn(self.train_score.score)
+        else:
+            g = jnp.asarray(grad, jnp.float32)
+            h = jnp.asarray(hess, jnp.float32)
+            if self.num_class > 1:
+                g = g.reshape(self.train_set.num_data, self.num_class)
+                h = h.reshape(self.train_set.num_data, self.num_class)
+        self._bagging(it, g, h)
+        fmask = self._feature_mask(it)
+        any_nonconstant = False
+        for k in range(self.num_tree_per_iteration):
+            ghc = self._tree_channels(g, h, k)
+            key = jax.random.fold_in(self._key, it * 131 + k)
+            log = self.learner.train(ghc, fmask, key)
+            tree = self._finalize_tree(log, k)
+            self.models.append(tree)
+            if tree.num_leaves > 1 or abs(tree.leaf_value[0]) > 0:
+                any_nonconstant = True
+        self.iter_ += 1
+        return not any_nonconstant
+
+    def _shrinkage_rate(self, log: TreeLog) -> float:
+        return float(self.config.learning_rate)
+
+    def _finalize_tree(self, log: TreeLog, class_id: int) -> Tree:
+        tree = self.learner.log_to_tree(log)
+        # objective-specific leaf renewal (reference:
+        # serial_tree_learner.cpp:684 RenewTreeOutput)
+        if self.objective.need_renew and tree.num_leaves > 1:
+            assign = np.asarray(log.row_leaf)
+            score_before = self.train_score.np()
+            renewed = self.objective.renew_leaf_values(
+                assign, tree.num_leaves, score_before)
+            if renewed is not None:
+                tree.leaf_value = renewed.astype(np.float64)
+        rate = self._shrinkage_rate(log)
+        tree.apply_shrinkage(rate)
+        # score updates: train via the partition the learner already holds
+        # (reference: score_updater.hpp:88), valid via device routing
+        self.train_score.add(tree.leaf_value, log.row_leaf, class_id,
+                             self.num_tree_per_iteration)
+        for _, vset, vscore in self.valid_sets:
+            vbins = self._valid_bins(vset)
+            vleaf = assign_leaves(vbins, log)
+            vscore.add(tree.leaf_value, vleaf, class_id, self.num_tree_per_iteration)
+        return tree
+
+    def _valid_bins(self, vset: BinnedDataset) -> jax.Array:
+        if not hasattr(vset, "_device_bins"):
+            vset._device_bins = jnp.asarray(vset.binned)
+        return vset._device_bins
+
+    def rollback_one_iter(self) -> None:
+        """(reference: gbdt.cpp:454 RollbackOneIter)"""
+        if self.iter_ <= 0:
+            return
+        for _ in range(self.num_tree_per_iteration):
+            tree = self.models.pop()
+            del tree
+        self.iter_ -= 1
+        # scores must be rebuilt; mark dirty and recompute lazily
+        self._rebuild_scores()
+
+    def _rebuild_scores(self) -> None:
+        K = self.num_tree_per_iteration
+
+        def fresh_tracker(ds: BinnedDataset) -> ScoreTracker:
+            ts = ScoreTracker(ds.num_data, K, self.init_scores)
+            if ds.metadata.init_score is not None:
+                base = ds.metadata.init_score
+                base = base.reshape(ds.num_data, -1) if self.num_class > 1 \
+                    else base.ravel()
+                ts.score = ts.score + jnp.asarray(base, jnp.float32)
+            return ts
+
+        ts = fresh_tracker(self.train_set)
+        for i, tree in enumerate(self.models):
+            leaf = self._route_tree_host(tree, self.train_set)
+            ts.add(tree.leaf_value, jnp.asarray(leaf), i % K, K)
+        self.train_score = ts
+        rebuilt = []
+        for name, vset, _ in self.valid_sets:
+            vs = fresh_tracker(vset)
+            for i, tree in enumerate(self.models):
+                leaf = self._route_tree_host(tree, vset)
+                vs.add(tree.leaf_value, jnp.asarray(leaf), i % K, K)
+            rebuilt.append((name, vset, vs))
+        self.valid_sets = rebuilt
+
+    # ------------------------------------------------------------------- eval
+    def eval_set(self, name: str, ds: BinnedDataset, tracker: ScoreTracker,
+                 feval=None) -> List[Tuple[str, str, float, bool]]:
+        out = []
+        score = tracker.score
+        conv = np.asarray(self.objective.convert_output(score))
+        md = ds.metadata
+        for m in self.metrics:
+            for mname, val in m.eval(conv, md.label, md.weight, md.query_boundaries):
+                out.append((name, mname, float(val), m.greater_is_better))
+        if feval is not None:
+            res = feval(np.asarray(score), ds)
+            if res:
+                if isinstance(res[0], (list, tuple)):
+                    for mname, val, gib in res:
+                        out.append((name, mname, float(val), bool(gib)))
+                else:
+                    mname, val, gib = res
+                    out.append((name, mname, float(val), bool(gib)))
+        return out
+
+    def eval_train(self, feval=None):
+        return self.eval_set("training", self.train_set, self.train_score, feval)
+
+    def eval_valid(self, feval=None):
+        out = []
+        for name, ds, tracker in self.valid_sets:
+            out.extend(self.eval_set(name, ds, tracker, feval))
+        return out
+
+    # ---------------------------------------------------------------- predict
+    def predict(self, X: np.ndarray, *, raw_score: bool = False,
+                start_iteration: int = 0, num_iteration: int = -1,
+                pred_leaf: bool = False) -> np.ndarray:
+        X = np.asarray(X, dtype=np.float64)
+        n = X.shape[0]
+        K = self.num_tree_per_iteration
+        total_iters = len(self.models) // max(K, 1)
+        if num_iteration is None or num_iteration <= 0:
+            num_iteration = total_iters - start_iteration
+        end = min(total_iters, start_iteration + num_iteration)
+        if pred_leaf:
+            out = np.zeros((n, (end - start_iteration) * K), dtype=np.int32)
+            for i in range(start_iteration * K, end * K):
+                out[:, i - start_iteration * K] = self.models[i].predict_leaf_index(X)
+            return out
+        score = np.zeros((n, K), dtype=np.float64)
+        score += self.init_scores[None, :K]
+        for i in range(start_iteration * K, end * K):
+            score[:, i % K] += self.models[i].predict(X)
+        if not raw_score and self.objective is not None:
+            score = np.asarray(self.objective.convert_output(jnp.asarray(score)))
+        if K == 1:
+            return score.ravel()
+        return score
+
+    # --------------------------------------------------------------- model IO
+    def model_to_string(self, num_iteration: int = -1) -> str:
+        """(reference: gbdt_model_text.cpp:400 SaveModelToString)"""
+        cfg = self.config
+        K = self.num_tree_per_iteration
+        total_iters = len(self.models) // max(K, 1)
+        if num_iteration is None or num_iteration <= 0:
+            num_iteration = total_iters
+        end = min(total_iters, num_iteration) * K
+        lines = [
+            "tree",
+            "version=v3",
+            "boosting=%s" % self.name,
+            "objective=%s" % self._objective_string(),
+            "num_class=%d" % self.num_class,
+            "num_tree_per_iteration=%d" % K,
+            "init_score=%s" % " ".join("%.17g" % v for v in self.init_scores),
+            "max_feature_idx=%d" % (self.train_set.num_total_features - 1
+                                    if self.train_set else -1),
+            "feature_names=%s" % " ".join(self.train_set.feature_names
+                                          if self.train_set else []),
+            "best_iteration=%d" % self.best_iteration,
+            "",
+        ]
+        for i, tree in enumerate(self.models[:end]):
+            lines.append("Tree=%d" % i)
+            lines.append(tree.to_text())
+            lines.append("")
+        lines.append("end of trees")
+        return "\n".join(lines)
+
+    def _objective_string(self) -> str:
+        obj = self.objective.name if self.objective else self.config.objective
+        if obj in ("multiclass", "multiclassova"):
+            return "%s num_class:%d" % (obj, self.num_class)
+        return obj
+
+    def save_model(self, filename: str, num_iteration: int = -1) -> None:
+        with open(filename, "w") as f:
+            f.write(self.model_to_string(num_iteration))
+
+    @classmethod
+    def model_from_string(cls, s: str, config: Optional[Config] = None) -> "GBDT":
+        config = config or Config()
+        header, _, rest = s.partition("Tree=")
+        kv: Dict[str, str] = {}
+        for line in header.splitlines():
+            if "=" in line:
+                k, v = line.split("=", 1)
+                kv[k] = v
+        obj_str = kv.get("objective", "regression").split()
+        config.objective = obj_str[0]
+        for tok in obj_str[1:]:
+            if tok.startswith("num_class:"):
+                config.num_class = int(tok.split(":")[1])
+        booster_cls = {"gbdt": cls, "dart": DART, "rf": RF}.get(
+            kv.get("boosting", "gbdt"), cls)
+        model = booster_cls.__new__(booster_cls)
+        GBDT.__init__(model, config, None)
+        model.num_tree_per_iteration = int(kv.get("num_tree_per_iteration", 1))
+        model.num_class = int(kv.get("num_class", 1))
+        init = kv.get("init_score", "0").split()
+        model.init_scores = np.asarray([float(v) for v in init], dtype=np.float64)
+        model.best_iteration = int(kv.get("best_iteration", -1))
+        model.objective = create_objective(config)
+        model._feature_names = kv.get("feature_names", "").split()
+        body = "Tree=" + rest
+        for block in body.split("Tree=")[1:]:
+            block = block.split("end of trees")[0]
+            lines = block.strip().splitlines()[1:]  # drop the index line remnant
+            # first line of block is "<idx>\n..." — strip leading index
+            model.models.append(Tree.from_text("\n".join(lines)))
+        model.iter_ = len(model.models) // max(model.num_tree_per_iteration, 1)
+        return model
+
+    def dump_json(self, num_iteration: int = -1) -> str:
+        K = self.num_tree_per_iteration
+        total_iters = len(self.models) // max(K, 1)
+        if num_iteration is None or num_iteration <= 0:
+            num_iteration = total_iters
+        end = min(total_iters, num_iteration) * K
+        d = {
+            "name": "tree",
+            "version": "v3",
+            "objective": self._objective_string(),
+            "num_class": self.num_class,
+            "num_tree_per_iteration": K,
+            "init_score": self.init_scores.tolist(),
+            "tree_info": [t.to_dict() for t in self.models[:end]],
+        }
+        return json.dumps(d)
+
+    @property
+    def current_iteration(self) -> int:
+        return self.iter_
+
+    def num_trees(self) -> int:
+        return len(self.models)
+
+    def feature_importance(self, importance_type: str = "split",
+                           iteration: int = -1) -> np.ndarray:
+        """(reference: GBDT::FeatureImportance, gbdt.cpp)"""
+        nf = self.train_set.num_total_features if self.train_set else (
+            max((t.split_feature.max() for t in self.models
+                 if t.num_leaves > 1), default=-1) + 1)
+        imp = np.zeros(nf, dtype=np.float64)
+        K = self.num_tree_per_iteration
+        end = len(self.models) if iteration <= 0 else min(
+            len(self.models), iteration * K)
+        for t in self.models[:end]:
+            if t.num_leaves <= 1:
+                continue
+            for r in range(t.num_internal):
+                if importance_type == "split":
+                    imp[t.split_feature[r]] += 1
+                else:
+                    imp[t.split_feature[r]] += max(0.0, float(t.split_gain[r]))
+        return imp
+
+
+class DART(GBDT):
+    """Dropout boosting (reference: src/boosting/dart.hpp)."""
+
+    name = "dart"
+
+    def __init__(self, config: Config, train_set: Optional[BinnedDataset],
+                 comm_axis: Optional[str] = None) -> None:
+        super().__init__(config, train_set, comm_axis)
+        self._tree_weights: List[float] = []
+        self._drop_rng = np.random.RandomState(config.drop_seed)
+
+    def train_one_iter(self, grad=None, hess=None) -> bool:
+        cfg = self.config
+        K = self.num_tree_per_iteration
+        # ---- select and subtract the drop set (dart.hpp:97 DroppingTrees) ----
+        drop: List[int] = []
+        if self._drop_rng.rand() >= cfg.skip_drop and self.iter_ > 0:
+            n_iters = self.iter_
+            if cfg.uniform_drop:
+                sel = self._drop_rng.rand(n_iters) < cfg.drop_rate
+                drop = list(np.flatnonzero(sel))
+            else:
+                p = min(1.0, cfg.drop_rate)
+                k_drop = min(cfg.max_drop, np.random.RandomState(
+                    cfg.drop_seed + self.iter_).binomial(n_iters, p))
+                if k_drop > 0:
+                    drop = list(self._drop_rng.choice(n_iters, size=k_drop,
+                                                      replace=False))
+        for it_idx in drop:
+            for k in range(K):
+                tree = self.models[it_idx * K + k]
+                self._apply_tree_delta(tree, k, -1.0)
+        k_cnt = len(drop)
+        # ---- train on the reduced score ----
+        stop = super().train_one_iter(grad, hess)
+        if stop:
+            # restore the dropped trees untouched so score trackers stay
+            # consistent when no tree could be grown
+            for it_idx in drop:
+                for k in range(K):
+                    self._apply_tree_delta(self.models[it_idx * K + k], k, 1.0)
+            return stop
+        # ---- normalize (dart.hpp:65 Normalize) ----
+        if not stop:
+            norm = 1.0 / (k_cnt + 1.0)
+            if cfg.xgboost_dart_mode:
+                norm = cfg.learning_rate / (k_cnt + cfg.learning_rate)
+            for k in range(K):
+                tree = self.models[-K + k]
+                # remove the freshly-added (unnormalized) contribution, rescale
+                self._apply_tree_delta(tree, k, norm - 1.0)
+                tree.apply_shrinkage(norm)
+            if k_cnt > 0:
+                factor = k_cnt / (k_cnt + 1.0)
+                if cfg.xgboost_dart_mode:
+                    factor = k_cnt / (k_cnt + cfg.learning_rate)
+                for it_idx in drop:
+                    for k in range(K):
+                        tree = self.models[it_idx * K + k]
+                        self._apply_tree_delta(tree, k, factor)
+                        tree.apply_shrinkage(factor)
+        return stop
+
+    def _shrinkage_rate(self, log: TreeLog) -> float:
+        # DART applies learning_rate at train time, normalization after
+        return float(self.config.learning_rate)
+
+    def _apply_tree_delta(self, tree: Tree, class_id: int, scale: float) -> None:
+        """Add ``scale`` × tree's contribution to train/valid scores."""
+        leaf_vals = tree.leaf_value * scale
+        leaf = self._route_tree_host(tree, self.train_set)
+        self.train_score.add(leaf_vals, jnp.asarray(leaf), class_id,
+                             self.num_tree_per_iteration)
+        for _, vset, vscore in self.valid_sets:
+            vleaf = self._route_tree_host(tree, vset)
+            vscore.add(leaf_vals, jnp.asarray(vleaf), class_id,
+                       self.num_tree_per_iteration)
+
+
+class RF(GBDT):
+    """Random forest mode (reference: src/boosting/rf.hpp): bagging is
+    mandatory, no shrinkage, scores are the average of tree outputs, and
+    gradients are always computed at the (constant) init score."""
+
+    name = "rf"
+
+    def __init__(self, config: Config, train_set: Optional[BinnedDataset],
+                 comm_axis: Optional[str] = None) -> None:
+        super().__init__(config, train_set, comm_axis)
+        if train_set is not None:
+            self._init_score_dev = self.train_score.score
+
+    def train_one_iter(self, grad=None, hess=None) -> bool:
+        if grad is None:
+            g, h = self._grad_fn(self._init_score_dev)
+        else:
+            g, h = jnp.asarray(grad, jnp.float32), jnp.asarray(hess, jnp.float32)
+        it = self.iter_
+        self._bagging(it, g, h)
+        fmask = self._feature_mask(it)
+        any_ok = False
+        for k in range(self.num_tree_per_iteration):
+            ghc = self._tree_channels(g, h, k)
+            key = jax.random.fold_in(self._key, it * 131 + k)
+            log = self.learner.train(ghc, fmask, key)
+            tree = self.learner.log_to_tree(log)
+            # averaged score: rescale previous sum then add (ref rf.hpp)
+            self.models.append(tree)
+            self._accumulate_avg(tree, log, k)
+            if tree.num_leaves > 1:
+                any_ok = True
+        self.iter_ += 1
+        return not any_ok
+
+    def _accumulate_avg(self, tree: Tree, log: TreeLog, class_id: int) -> None:
+        it = self.iter_  # completed iterations before this one
+        K = self.num_tree_per_iteration
+        # running average over iterations: new_avg = (old*it + tree)/(it+1)
+        if self.num_class > 1:
+            init_col = self.init_scores[class_id]
+            old = self.train_score.score[:, class_id] - init_col
+            new = (old * it + jnp.asarray(tree.leaf_value, jnp.float32)[log.row_leaf]) \
+                / (it + 1)
+            self.train_score.score = self.train_score.score.at[:, class_id].set(
+                new + init_col)
+        else:
+            old = self.train_score.score - self.init_scores[0]
+            new = (old * it + jnp.asarray(tree.leaf_value, jnp.float32)[log.row_leaf]) \
+                / (it + 1)
+            self.train_score.score = new + self.init_scores[0]
+        for _, vset, vscore in self.valid_sets:
+            vleaf = assign_leaves(self._valid_bins(vset), log)
+            vals = jnp.asarray(tree.leaf_value, jnp.float32)[vleaf]
+            if self.num_class > 1:
+                init_col = self.init_scores[class_id]
+                old = vscore.score[:, class_id] - init_col
+                vscore.score = vscore.score.at[:, class_id].set(
+                    (old * it + vals) / (it + 1) + init_col)
+            else:
+                old = vscore.score - self.init_scores[0]
+                vscore.score = (old * it + vals) / (it + 1) + self.init_scores[0]
+
+    def predict(self, X, *, raw_score=False, start_iteration=0,
+                num_iteration=-1, pred_leaf=False):
+        X = np.asarray(X, dtype=np.float64)
+        n = X.shape[0]
+        K = self.num_tree_per_iteration
+        total_iters = len(self.models) // max(K, 1)
+        if num_iteration is None or num_iteration <= 0:
+            num_iteration = total_iters - start_iteration
+        end = min(total_iters, start_iteration + num_iteration)
+        if pred_leaf:
+            return super().predict(X, raw_score=raw_score,
+                                   start_iteration=start_iteration,
+                                   num_iteration=num_iteration, pred_leaf=True)
+        score = np.zeros((n, K), dtype=np.float64)
+        cnt = max(1, end - start_iteration)
+        for i in range(start_iteration * K, end * K):
+            score[:, i % K] += self.models[i].predict(X)
+        score /= cnt
+        score += self.init_scores[None, :K]
+        if not raw_score and self.objective is not None:
+            score = np.asarray(self.objective.convert_output(jnp.asarray(score)))
+        return score.ravel() if K == 1 else score
+
+
+def create_boosting(config: Config, train_set: Optional[BinnedDataset],
+                    comm_axis: Optional[str] = None) -> GBDT:
+    """Factory (reference: src/boosting/boosting.cpp:35 CreateBoosting)."""
+    kind = config.boosting
+    if kind in ("gbdt", "gbrt", "goss"):
+        return GBDT(config, train_set, comm_axis)
+    if kind == "dart":
+        return DART(config, train_set, comm_axis)
+    if kind in ("rf", "random_forest"):
+        return RF(config, train_set, comm_axis)
+    Log.fatal("Unknown boosting type: %s", kind)

@@ -1,0 +1,147 @@
+"""Training callbacks (reference: python-package/lightgbm/callback.py:51-146
+print_evaluation / record_evaluation / reset_parameter / early_stopping, with
+the same CallbackEnv protocol)."""
+from __future__ import annotations
+
+import collections
+from typing import Any, Callable, Dict, List, Union
+
+from .utils.log import Log
+
+CallbackEnv = collections.namedtuple(
+    "CallbackEnv",
+    ["model", "params", "iteration", "begin_iteration", "end_iteration",
+     "evaluation_result_list"])
+
+
+class EarlyStopException(Exception):
+    """(reference: callback.py EarlyStopException)"""
+
+    def __init__(self, best_iteration: int, best_score) -> None:
+        super().__init__()
+        self.best_iteration = best_iteration
+        self.best_score = best_score
+
+
+def _fmt_eval(res) -> str:
+    name, metric, value, _ = res[:4]
+    return "%s's %s: %g" % (name, metric, value)
+
+
+def log_evaluation(period: int = 1, show_stdv: bool = True) -> Callable:
+    """(reference: callback.py:51 print_evaluation)"""
+
+    def _callback(env: CallbackEnv) -> None:
+        if period > 0 and env.evaluation_result_list \
+                and (env.iteration + 1) % period == 0:
+            result = "\t".join(_fmt_eval(x) for x in env.evaluation_result_list)
+            Log.info("[%d]\t%s", env.iteration + 1, result)
+
+    _callback.order = 10
+    return _callback
+
+
+print_evaluation = log_evaluation
+
+
+def record_evaluation(eval_result: Dict[str, Dict[str, List[float]]]) -> Callable:
+    """(reference: callback.py:74)"""
+    if not isinstance(eval_result, dict):
+        raise TypeError("eval_result should be a dictionary")
+
+    def _init(env: CallbackEnv) -> None:
+        for name, metric, _, _ in env.evaluation_result_list or []:
+            eval_result.setdefault(name, collections.OrderedDict())
+            eval_result[name].setdefault(metric, [])
+
+    def _callback(env: CallbackEnv) -> None:
+        if not eval_result:
+            _init(env)
+        for name, metric, value, _ in env.evaluation_result_list or []:
+            eval_result.setdefault(name, collections.OrderedDict())
+            eval_result[name].setdefault(metric, []).append(value)
+
+    _callback.order = 20
+    return _callback
+
+
+def reset_parameter(**kwargs: Union[list, Callable]) -> Callable:
+    """Schedule parameters by iteration, e.g. learning_rate=list|fn
+    (reference: callback.py:105)."""
+
+    def _callback(env: CallbackEnv) -> None:
+        new_params = {}
+        for key, value in kwargs.items():
+            if isinstance(value, list):
+                if len(value) != env.end_iteration - env.begin_iteration:
+                    raise ValueError("Length of list %r should equal num_boost_round"
+                                     % key)
+                new_params[key] = value[env.iteration - env.begin_iteration]
+            elif callable(value):
+                new_params[key] = value(env.iteration - env.begin_iteration)
+        if new_params:
+            env.model.reset_parameter(new_params)
+
+    _callback.before_iteration = True
+    _callback.order = 10
+    return _callback
+
+
+def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
+                   verbose: bool = True, min_delta: float = 0.0) -> Callable:
+    """(reference: callback.py:146)"""
+    best_score: List[float] = []
+    best_iter: List[int] = []
+    best_score_list: List[Any] = []
+    cmp_op: List[Callable] = []
+    enabled = [True]
+    first_metric = [""]
+
+    def _init(env: CallbackEnv) -> None:
+        enabled[0] = bool(env.evaluation_result_list)
+        if not enabled[0]:
+            Log.warning("Early stopping requires at least one validation set")
+            return
+        if verbose:
+            Log.info("Training until validation scores don't improve for %d rounds",
+                     stopping_rounds)
+        first_metric[0] = env.evaluation_result_list[0][1]
+        for _, _, _, greater_is_better in env.evaluation_result_list:
+            best_iter.append(0)
+            best_score_list.append(None)
+            if greater_is_better:
+                best_score.append(float("-inf"))
+                cmp_op.append(lambda x, y: x > y + min_delta)
+            else:
+                best_score.append(float("inf"))
+                cmp_op.append(lambda x, y: x < y - min_delta)
+
+    def _callback(env: CallbackEnv) -> None:
+        if not best_score:
+            _init(env)
+        if not enabled[0]:
+            return
+        for i, (name, metric, value, _) in enumerate(env.evaluation_result_list):
+            if best_score_list[i] is None or cmp_op[i](value, best_score[i]):
+                best_score[i] = value
+                best_iter[i] = env.iteration
+                best_score_list[i] = env.evaluation_result_list
+            if first_metric_only and first_metric[0] != metric:
+                continue
+            if name == "training":
+                continue
+            if env.iteration - best_iter[i] >= stopping_rounds:
+                if verbose:
+                    Log.info("Early stopping, best iteration is: [%d]\t%s",
+                             best_iter[i] + 1,
+                             "\t".join(_fmt_eval(x) for x in best_score_list[i]))
+                raise EarlyStopException(best_iter[i], best_score_list[i])
+            if env.iteration == env.end_iteration - 1:
+                if verbose:
+                    Log.info("Did not meet early stopping. Best iteration is: [%d]\t%s",
+                             best_iter[i] + 1,
+                             "\t".join(_fmt_eval(x) for x in best_score_list[i]))
+                raise EarlyStopException(best_iter[i], best_score_list[i])
+
+    _callback.order = 30
+    return _callback

@@ -291,6 +291,10 @@ def _make_groups(
     n = len(used)
     sparse_ok = [enable_bundle and m.sparse_rate >= 0.8 and m.bin_type == BIN_NUMERICAL
                  for m in mappers]
+    if not any(sparse_ok):
+        # dense data: every feature is its own group, skip the conflict scan
+        groups = [FeatureGroupInfo([i], [0], mappers[i].num_bins) for i in range(n)]
+        return (groups, np.arange(n, dtype=np.int32), np.zeros(n, dtype=np.int32))
     groups: List[FeatureGroupInfo] = []
     feature_to_group = np.zeros(n, dtype=np.int32)
     feature_offset = np.zeros(n, dtype=np.int32)

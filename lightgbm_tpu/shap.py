@@ -1,0 +1,156 @@
+"""SHAP feature contributions for tree ensembles.
+
+Equivalent of the reference's TreeSHAP implementation
+(reference: src/io/tree.cpp TreeSHAP / PredictContrib, based on Lundberg &
+Lee's exact tree SHAP with EXPECTED-value path attribution). Host
+implementation; per-row recursion over each tree's paths.
+"""
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from .tree import Tree
+
+
+class _PathElem:
+    __slots__ = ("feature_index", "zero_fraction", "one_fraction", "pweight")
+
+    def __init__(self, feature_index, zero_fraction, one_fraction, pweight):
+        self.feature_index = feature_index
+        self.zero_fraction = zero_fraction
+        self.one_fraction = one_fraction
+        self.pweight = pweight
+
+
+def _extend_path(path: List[_PathElem], zero_fraction, one_fraction, feature_index):
+    path.append(_PathElem(feature_index, zero_fraction, one_fraction,
+                          1.0 if len(path) == 0 else 0.0))
+    n = len(path) - 1
+    for i in range(n - 1, -1, -1):
+        path[i + 1].pweight += one_fraction * path[i].pweight * (i + 1) / (n + 1)
+        path[i].pweight = zero_fraction * path[i].pweight * (n - i) / (n + 1)
+
+
+def _unwind_path(path: List[_PathElem], path_index):
+    n = len(path) - 1
+    one_fraction = path[path_index].one_fraction
+    zero_fraction = path[path_index].zero_fraction
+    next_one_portion = path[n].pweight
+    for i in range(n - 1, -1, -1):
+        if one_fraction != 0:
+            tmp = path[i].pweight
+            path[i].pweight = next_one_portion * (n + 1) / ((i + 1) * one_fraction)
+            next_one_portion = tmp - path[i].pweight * zero_fraction * (n - i) / (n + 1)
+        else:
+            path[i].pweight = path[i].pweight * (n + 1) / (zero_fraction * (n - i))
+    for i in range(path_index, n):
+        path[i].feature_index = path[i + 1].feature_index
+        path[i].zero_fraction = path[i + 1].zero_fraction
+        path[i].one_fraction = path[i + 1].one_fraction
+    path.pop()
+
+
+def _unwound_sum(path: List[_PathElem], path_index):
+    n = len(path) - 1
+    one_fraction = path[path_index].one_fraction
+    zero_fraction = path[path_index].zero_fraction
+    next_one_portion = path[n].pweight
+    total = 0.0
+    for i in range(n - 1, -1, -1):
+        if one_fraction != 0:
+            tmp = next_one_portion * (n + 1) / ((i + 1) * one_fraction)
+            total += tmp
+            next_one_portion = path[i].pweight - tmp * zero_fraction * (n - i) / (n + 1)
+        else:
+            total += path[i].pweight / (zero_fraction * (n - i) / (n + 1))
+    return total
+
+
+def _tree_shap_row(tree: Tree, x: np.ndarray, phi: np.ndarray, node: int,
+                   path: List[_PathElem], parent_zero: float, parent_one: float,
+                   parent_feature: int) -> None:
+    path = [
+        _PathElem(p.feature_index, p.zero_fraction, p.one_fraction, p.pweight)
+        for p in path]
+    _extend_path(path, parent_zero, parent_one, parent_feature)
+    if node < 0:  # leaf
+        leaf = ~node
+        for i in range(1, len(path)):
+            w = _unwound_sum(path, i)
+            phi[path[i].feature_index] += w * (path[i].one_fraction -
+                                               path[i].zero_fraction) \
+                * tree.leaf_value[leaf]
+        return
+    f = int(tree.split_feature[node])
+    go_left = bool(tree._decide(node, np.asarray([x[f]]))[0])
+    hot = tree.left_child[node] if go_left else tree.right_child[node]
+    cold = tree.right_child[node] if go_left else tree.left_child[node]
+    w_node = _node_weight(tree, node)
+    w_hot = _child_weight(tree, hot)
+    w_cold = _child_weight(tree, cold)
+    incoming_zero, incoming_one = 1.0, 1.0
+    path_index = -1
+    for i in range(1, len(path)):
+        if path[i].feature_index == f:
+            path_index = i
+            break
+    if path_index >= 0:
+        incoming_zero = path[path_index].zero_fraction
+        incoming_one = path[path_index].one_fraction
+        _unwind_path(path, path_index)
+    _tree_shap_row(tree, x, phi, hot, path,
+                   w_hot / w_node * incoming_zero, incoming_one, f)
+    _tree_shap_row(tree, x, phi, cold, path,
+                   w_cold / w_node * incoming_zero, 0.0, f)
+
+
+def _node_weight(tree: Tree, node: int) -> float:
+    if node < 0:
+        return max(float(tree.leaf_count[~node]), 1e-10)
+    return max(float(tree.internal_count[node]), 1e-10)
+
+
+def _child_weight(tree: Tree, child: int) -> float:
+    return _node_weight(tree, child)
+
+
+def _expected_value(tree: Tree, node: int = 0) -> float:
+    if tree.num_leaves == 1:
+        return float(tree.leaf_value[0])
+    if node < 0:
+        return float(tree.leaf_value[~node])
+    wl = _node_weight(tree, tree.left_child[node])
+    wr = _node_weight(tree, tree.right_child[node])
+    tot = wl + wr
+    return (wl * _expected_value(tree, tree.left_child[node]) +
+            wr * _expected_value(tree, tree.right_child[node])) / tot
+
+
+def tree_shap_contribs(gbdt, X: np.ndarray, num_iteration=-1) -> np.ndarray:
+    """(n, F+1) contributions per class, concatenated over classes like the
+    reference's PredictContrib layout (c_api PredictForMat contrib)."""
+    X = np.asarray(X, dtype=np.float64)
+    n = X.shape[0]
+    num_feat = gbdt.train_set.num_total_features if gbdt.train_set else X.shape[1]
+    K = gbdt.num_tree_per_iteration
+    total_iters = len(gbdt.models) // max(K, 1)
+    if num_iteration is None or num_iteration <= 0:
+        num_iteration = total_iters
+    end = min(total_iters, num_iteration) * K
+    out = np.zeros((n, K, num_feat + 1), dtype=np.float64)
+    for i, tree in enumerate(gbdt.models[:end]):
+        k = i % K
+        base = _expected_value(tree)
+        out[:, k, -1] += base
+        if tree.num_leaves <= 1:
+            continue
+        for r in range(n):
+            phi = np.zeros(num_feat + 1)
+            _tree_shap_row(tree, X[r], phi, 0, [], 1.0, 1.0, -1)
+            out[r, k, :num_feat] += phi[:num_feat]
+    out[:, :, -1] += gbdt.init_scores[None, :K]
+    if K == 1:
+        return out[:, 0, :]
+    return out.reshape(n, K * (num_feat + 1))

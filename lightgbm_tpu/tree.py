@@ -1,0 +1,333 @@
+"""Leaf-wise decision tree model: fixed-capacity arrays + prediction.
+
+TPU-native equivalent of the reference ``Tree`` (reference:
+include/LightGBM/tree.h:25, src/io/tree.cpp). Differences by design:
+
+- Trees are *built on device* by the jitted learner as a flat "split log"
+  (one record per split round); this class reconstructs the standard
+  internal-node/leaf structure on host for prediction and serialization.
+- Prediction over a batch of rows is vectorized (numpy on host, and the
+  learner routes binned rows on device with per-split bin tables), instead
+  of the reference's per-row pointer walk (tree.h:133 NumericalDecision).
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+# decision_type bit layout (reference: include/LightGBM/tree.h:149-166)
+K_CATEGORICAL_MASK = 1
+K_DEFAULT_LEFT_MASK = 2
+# missing type stored in bits 2-3 (values 0=None,1=Zero,2=NaN)
+
+
+class Tree:
+    """A fitted decision tree with ``num_leaves`` leaves.
+
+    Internal node ``i`` (0-based, creation order) holds a split; children are
+    node indices where negative values encode leaves: leaf ``j`` is stored as
+    ``~j`` (reference: tree.h left_child_/right_child_ convention).
+    """
+
+    def __init__(self, num_leaves: int, has_categorical: bool = False) -> None:
+        n = max(num_leaves - 1, 1)
+        self.num_leaves = num_leaves
+        self.split_feature: np.ndarray = np.zeros(n, dtype=np.int32)  # real feature idx
+        self.split_bin: np.ndarray = np.zeros(n, dtype=np.int32)
+        self.threshold: np.ndarray = np.zeros(n, dtype=np.float64)
+        self.decision_type: np.ndarray = np.zeros(n, dtype=np.int8)
+        self.left_child: np.ndarray = np.full(n, -1, dtype=np.int32)
+        self.right_child: np.ndarray = np.full(n, -1, dtype=np.int32)
+        self.split_gain: np.ndarray = np.zeros(n, dtype=np.float32)
+        self.internal_value: np.ndarray = np.zeros(n, dtype=np.float64)
+        self.internal_weight: np.ndarray = np.zeros(n, dtype=np.float64)
+        self.internal_count: np.ndarray = np.zeros(n, dtype=np.int64)
+        self.leaf_value: np.ndarray = np.zeros(num_leaves, dtype=np.float64)
+        self.leaf_weight: np.ndarray = np.zeros(num_leaves, dtype=np.float64)
+        self.leaf_count: np.ndarray = np.zeros(num_leaves, dtype=np.int64)
+        self.leaf_parent: np.ndarray = np.full(num_leaves, -1, dtype=np.int32)
+        # categorical split i -> sorted array of category values going LEFT
+        self.cat_threshold: Dict[int, np.ndarray] = {}
+        self.shrinkage: float = 1.0
+        self.num_cat: int = 0
+
+    # ------------------------------------------------------------------ build
+    @classmethod
+    def from_split_log(
+        cls,
+        num_splits: int,
+        split_leaf: np.ndarray,      # (R,) leaf index split at round r
+        split_feature: np.ndarray,   # (R,) inner feature index
+        split_bin: np.ndarray,       # (R,) threshold bin
+        default_left: np.ndarray,    # (R,) bool
+        split_gain: np.ndarray,      # (R,)
+        left_sum: np.ndarray,        # (R, 3) g,h,cnt of left child at split time
+        right_sum: np.ndarray,       # (R, 3)
+        leaf_value: np.ndarray,      # (num_leaves,) final leaf outputs
+        *,
+        bin_mappers: Sequence[Any],
+        real_feature_index: Sequence[int],
+        go_left_table: Optional[np.ndarray] = None,  # (R, B) bool, categorical splits
+        is_categorical: Optional[np.ndarray] = None,  # (R,) bool
+    ) -> "Tree":
+        """Rebuild the node structure from the learner's split log.
+
+        Round ``r`` splits leaf ``l``: internal node ``r`` is created, the left
+        child keeps leaf index ``l`` and the right child becomes leaf ``r+1``
+        (reference: Tree::Split semantics, tree.h:62 — same leaf-index reuse).
+        """
+        num_leaves = num_splits + 1
+        t = cls(num_leaves)
+        # leaf -> node currently representing it (-1 while it is the root)
+        leaf_slot: Dict[int, tuple] = {0: (-1, 0)}  # leaf -> (parent node, side 0=L 1=R)
+        for r in range(num_splits):
+            l = int(split_leaf[r])
+            inner_f = int(split_feature[r])
+            mapper = bin_mappers[inner_f]
+            t.split_feature[r] = int(real_feature_index[inner_f])
+            t.split_bin[r] = int(split_bin[r])
+            dtyp = 0
+            if is_categorical is not None and bool(is_categorical[r]):
+                dtyp |= K_CATEGORICAL_MASK
+                t.num_cat += 1
+                # table row -> real category values going left
+                tbl = go_left_table[r, : mapper.num_bins]
+                bins_left = np.flatnonzero(tbl)
+                cats = [mapper.bin_to_value(int(b)) for b in bins_left
+                        if b < len(mapper.categories)]
+                t.cat_threshold[r] = np.asarray(sorted(int(c) for c in cats), dtype=np.int64)
+                t.threshold[r] = float(len(t.cat_threshold))  # placeholder index-ish
+            else:
+                if bool(default_left[r]):
+                    dtyp |= K_DEFAULT_LEFT_MASK
+                dtyp |= (mapper.missing_type & 3) << 2
+                t.threshold[r] = mapper.bin_to_value(int(split_bin[r]))
+            t.decision_type[r] = dtyp
+            t.split_gain[r] = float(split_gain[r])
+            gl, hl, cl = (float(left_sum[r, 0]), float(left_sum[r, 1]), float(left_sum[r, 2]))
+            gr, hr, cr = (float(right_sum[r, 0]), float(right_sum[r, 1]), float(right_sum[r, 2]))
+            t.internal_weight[r] = hl + hr
+            t.internal_count[r] = int(round(cl + cr))
+            tot_h = hl + hr
+            t.internal_value[r] = -(gl + gr) / tot_h if tot_h > 0 else 0.0
+            # hook up parent pointer
+            parent, side = leaf_slot.pop(l)
+            if parent >= 0:
+                if side == 0:
+                    t.left_child[parent] = r
+                else:
+                    t.right_child[parent] = r
+            new_leaf = r + 1
+            t.left_child[r] = ~l
+            t.right_child[r] = ~new_leaf
+            t.leaf_parent[l] = r
+            t.leaf_parent[new_leaf] = r
+            t.leaf_weight[l], t.leaf_count[l] = hl, int(round(cl))
+            t.leaf_weight[new_leaf], t.leaf_count[new_leaf] = hr, int(round(cr))
+            leaf_slot[l] = (r, 0)
+            leaf_slot[new_leaf] = (r, 1)
+        t.leaf_value[:num_leaves] = np.asarray(leaf_value[:num_leaves], dtype=np.float64)
+        return t
+
+    # ---------------------------------------------------------------- predict
+    def _decide(self, node: int, values: np.ndarray) -> np.ndarray:
+        """Vectorized left/right decision for internal node over raw values.
+
+        Mirrors reference NumericalDecision / CategoricalDecision
+        (tree.h:133-166): missing handling None (NaN->0), Zero (NaN->0 and
+        |x|<=kZeroThreshold treated by threshold compare), NaN (default dir).
+        Returns bool array: True -> go left.
+        """
+        dt = int(self.decision_type[node])
+        if dt & K_CATEGORICAL_MASK:
+            cats = self.cat_threshold.get(node, np.array([], dtype=np.int64))
+            iv = np.where(np.isfinite(values), values, -1).astype(np.int64)
+            return np.isin(iv, cats)
+        thr = self.threshold[node]
+        missing_type = (dt >> 2) & 3
+        default_left = bool(dt & K_DEFAULT_LEFT_MASK)
+        nan_mask = np.isnan(values)
+        if missing_type == 2:  # NaN-aware
+            base = values <= thr
+            return np.where(nan_mask, default_left, base)
+        # None/Zero: NaN behaves as 0 (reference tree.h:133 converts)
+        v = np.where(nan_mask, 0.0, values)
+        return v <= thr
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        """Batch prediction of leaf outputs for raw feature rows."""
+        X = np.asarray(X, dtype=np.float64)
+        n = X.shape[0]
+        if self.num_leaves <= 1:
+            return np.full(n, self.leaf_value[0], dtype=np.float64)
+        node = np.zeros(n, dtype=np.int64)  # >=0 internal, <0 leaf (~leaf)
+        active = node >= 0
+        while np.any(active):
+            for nd in np.unique(node[active]):
+                sel = active & (node == nd)
+                go_left = self._decide(int(nd), X[sel, self.split_feature[nd]])
+                nxt = np.where(go_left, self.left_child[nd], self.right_child[nd])
+                node[sel] = nxt
+            active = node >= 0
+        return self.leaf_value[~node]
+
+    def predict_leaf_index(self, X: np.ndarray) -> np.ndarray:
+        X = np.asarray(X, dtype=np.float64)
+        n = X.shape[0]
+        if self.num_leaves <= 1:
+            return np.zeros(n, dtype=np.int32)
+        node = np.zeros(n, dtype=np.int64)
+        active = node >= 0
+        while np.any(active):
+            for nd in np.unique(node[active]):
+                sel = active & (node == nd)
+                go_left = self._decide(int(nd), X[sel, self.split_feature[nd]])
+                node[sel] = np.where(go_left, self.left_child[nd], self.right_child[nd])
+            active = node >= 0
+        return (~node).astype(np.int32)
+
+    def apply_shrinkage(self, rate: float) -> None:
+        """(reference: tree.h:187 Shrinkage)"""
+        self.leaf_value *= rate
+        self.internal_value *= rate
+        self.shrinkage *= rate
+
+    def add_bias(self, val: float) -> None:
+        self.leaf_value += val
+        self.internal_value += val
+
+    @property
+    def num_internal(self) -> int:
+        return self.num_leaves - 1
+
+    def leaf_depths(self) -> np.ndarray:
+        depth = np.zeros(self.num_leaves, dtype=np.int32)
+        if self.num_leaves <= 1:
+            return depth
+        node_depth = np.zeros(self.num_internal, dtype=np.int32)
+        for r in range(self.num_internal):
+            for child in (self.left_child[r], self.right_child[r]):
+                if child >= 0:
+                    node_depth[child] = node_depth[r] + 1
+                else:
+                    depth[~child] = node_depth[r] + 1
+        return depth
+
+    # -------------------------------------------------------------- serialize
+    def to_dict(self) -> Dict[str, Any]:
+        d = {
+            "num_leaves": int(self.num_leaves),
+            "num_cat": int(self.num_cat),
+            "shrinkage": float(self.shrinkage),
+            "leaf_value": self.leaf_value.tolist(),
+            "leaf_weight": self.leaf_weight.tolist(),
+            "leaf_count": self.leaf_count.tolist(),
+        }
+        if self.num_leaves > 1:
+            d.update({
+                "split_feature": self.split_feature.tolist(),
+                "split_gain": self.split_gain.tolist(),
+                "threshold": self.threshold.tolist(),
+                "decision_type": self.decision_type.tolist(),
+                "left_child": self.left_child.tolist(),
+                "right_child": self.right_child.tolist(),
+                "internal_value": self.internal_value.tolist(),
+                "internal_weight": self.internal_weight.tolist(),
+                "internal_count": self.internal_count.tolist(),
+                "cat_threshold": {str(k): v.tolist() for k, v in self.cat_threshold.items()},
+            })
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Tree":
+        t = cls(int(d["num_leaves"]))
+        t.shrinkage = float(d.get("shrinkage", 1.0))
+        t.num_cat = int(d.get("num_cat", 0))
+        t.leaf_value = np.asarray(d["leaf_value"], dtype=np.float64)
+        t.leaf_weight = np.asarray(d.get("leaf_weight", np.zeros(t.num_leaves)), dtype=np.float64)
+        t.leaf_count = np.asarray(d.get("leaf_count", np.zeros(t.num_leaves)), dtype=np.int64)
+        if t.num_leaves > 1:
+            t.split_feature = np.asarray(d["split_feature"], dtype=np.int32)
+            t.split_gain = np.asarray(d["split_gain"], dtype=np.float32)
+            t.threshold = np.asarray(d["threshold"], dtype=np.float64)
+            t.decision_type = np.asarray(d["decision_type"], dtype=np.int8)
+            t.left_child = np.asarray(d["left_child"], dtype=np.int32)
+            t.right_child = np.asarray(d["right_child"], dtype=np.int32)
+            t.internal_value = np.asarray(d["internal_value"], dtype=np.float64)
+            t.internal_weight = np.asarray(d["internal_weight"], dtype=np.float64)
+            t.internal_count = np.asarray(d["internal_count"], dtype=np.int64)
+            t.cat_threshold = {int(k): np.asarray(v, dtype=np.int64)
+                               for k, v in d.get("cat_threshold", {}).items()}
+        return t
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict())
+
+    def to_text(self) -> str:
+        """Text block in the spirit of the reference model format
+        (reference: src/boosting/gbdt_model_text.cpp:311 ``Tree=N`` blocks)."""
+        lines = [
+            "num_leaves=%d" % self.num_leaves,
+            "num_cat=%d" % self.num_cat,
+            "shrinkage=%g" % self.shrinkage,
+            "leaf_value=" + " ".join("%.17g" % v for v in self.leaf_value),
+            "leaf_weight=" + " ".join("%g" % v for v in self.leaf_weight),
+            "leaf_count=" + " ".join(str(int(v)) for v in self.leaf_count),
+        ]
+        if self.num_leaves > 1:
+            lines += [
+                "split_feature=" + " ".join(str(v) for v in self.split_feature),
+                "split_gain=" + " ".join("%g" % v for v in self.split_gain),
+                "threshold=" + " ".join("%.17g" % v for v in self.threshold),
+                "decision_type=" + " ".join(str(int(v)) for v in self.decision_type),
+                "left_child=" + " ".join(str(v) for v in self.left_child),
+                "right_child=" + " ".join(str(v) for v in self.right_child),
+                "internal_value=" + " ".join("%g" % v for v in self.internal_value),
+                "internal_weight=" + " ".join("%g" % v for v in self.internal_weight),
+                "internal_count=" + " ".join(str(int(v)) for v in self.internal_count),
+            ]
+            if self.cat_threshold:
+                cat_items = ["%d:%s" % (k, ",".join(str(c) for c in v))
+                             for k, v in sorted(self.cat_threshold.items())]
+                lines.append("cat_threshold=" + ";".join(cat_items))
+        return "\n".join(lines)
+
+    @classmethod
+    def from_text(cls, block: str) -> "Tree":
+        kv: Dict[str, str] = {}
+        for line in block.strip().splitlines():
+            if "=" in line:
+                k, v = line.split("=", 1)
+                kv[k.strip()] = v.strip()
+        t = cls(int(kv["num_leaves"]))
+        t.num_cat = int(kv.get("num_cat", "0"))
+        t.shrinkage = float(kv.get("shrinkage", "1"))
+
+        def arr(key: str, dtype, size: int) -> np.ndarray:
+            if key not in kv or kv[key] == "":
+                return np.zeros(size, dtype=dtype)
+            return np.asarray([float(x) for x in kv[key].split()], dtype=dtype)
+
+        L = t.num_leaves
+        t.leaf_value = arr("leaf_value", np.float64, L)
+        t.leaf_weight = arr("leaf_weight", np.float64, L)
+        t.leaf_count = arr("leaf_count", np.int64, L)
+        if L > 1:
+            n = L - 1
+            t.split_feature = arr("split_feature", np.int32, n)
+            t.split_gain = arr("split_gain", np.float32, n)
+            t.threshold = arr("threshold", np.float64, n)
+            t.decision_type = arr("decision_type", np.int8, n)
+            t.left_child = arr("left_child", np.int32, n)
+            t.right_child = arr("right_child", np.int32, n)
+            t.internal_value = arr("internal_value", np.float64, n)
+            t.internal_weight = arr("internal_weight", np.float64, n)
+            t.internal_count = arr("internal_count", np.int64, n)
+            if kv.get("cat_threshold"):
+                for item in kv["cat_threshold"].split(";"):
+                    k, cats = item.split(":")
+                    t.cat_threshold[int(k)] = np.asarray(
+                        [int(c) for c in cats.split(",") if c], dtype=np.int64)
+        return t

@@ -7,10 +7,16 @@ build a jax.sharding.Mesh over them.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Hard-force the CPU host platform: the axon sitecustomize registers the TPU
+# backend regardless of JAX_PLATFORMS unless its trigger env var is absent.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = _flags + " --xla_force_host_platform_device_count=8"
+# persistent compile cache: the jitted tree builder dominates test wall-clock
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/lgb_tpu_jax_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

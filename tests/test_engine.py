@@ -1,0 +1,278 @@
+"""End-to-end behavioral tests (model: reference
+tests/python_package_test/test_engine.py — train/eval on synthetic data,
+every objective family, model IO round-trips, early stopping)."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _reg_data(rng, n=1500, f=8):
+    X = rng.randn(n, f)
+    y = 2 * X[:, 0] + np.sin(3 * X[:, 1]) + 0.5 * X[:, 2] ** 2 + 0.05 * rng.randn(n)
+    return X, y
+
+
+def _bin_data(rng, n=2000, f=8):
+    X = rng.randn(n, f)
+    y = (2 * X[:, 0] - X[:, 1] + 0.3 * rng.randn(n) > 0).astype(np.float64)
+    return X, y
+
+
+BASE = {"verbosity": -1, "num_leaves": 15, "min_data_in_leaf": 5,
+        "learning_rate": 0.15}
+
+
+def test_regression_improves(rng):
+    X, y = _reg_data(rng)
+    bst = lgb.train({**BASE, "objective": "regression"},
+                    lgb.Dataset(X, label=y), num_boost_round=40)
+    mse = float(np.mean((bst.predict(X) - y) ** 2))
+    assert mse < 0.1 * float(np.var(y))
+
+
+def test_binary_auc(rng):
+    X, y = _bin_data(rng)
+    bst = lgb.train({**BASE, "objective": "binary", "metric": ["auc"]},
+                    lgb.Dataset(X, label=y), num_boost_round=40)
+    (_, _, auc, _), = bst.eval_train()
+    assert auc > 0.97
+    p = bst.predict(X)
+    assert 0 <= p.min() and p.max() <= 1
+
+
+@pytest.mark.parametrize("objective", [
+    "regression_l1", "huber", "fair", "quantile", "mape"])
+def test_robust_regression_objectives(rng, objective):
+    X, y = _reg_data(rng)
+    # alpha=0.5 makes quantile an L1 fit so the MAE check below applies
+    bst = lgb.train({**BASE, "objective": objective, "alpha": 0.5},
+                    lgb.Dataset(X, label=y), num_boost_round=30)
+    mae = float(np.mean(np.abs(bst.predict(X) - y)))
+    base_mae = float(np.mean(np.abs(y - np.median(y))))
+    assert mae < 0.5 * base_mae
+
+
+@pytest.mark.parametrize("objective", ["poisson", "gamma", "tweedie"])
+def test_positive_regression_objectives(rng, objective):
+    X, _ = _reg_data(rng)
+    y = np.exp(0.5 * X[:, 0] + 0.2 * X[:, 1]) + 0.01
+    bst = lgb.train({**BASE, "objective": objective},
+                    lgb.Dataset(X, label=y), num_boost_round=40)
+    p = bst.predict(X)
+    assert p.min() > 0
+    corr = np.corrcoef(np.log(p), np.log(y))[0, 1]
+    assert corr > 0.8
+
+
+def test_multiclass(rng):
+    X = rng.randn(2000, 6)
+    y = ((X[:, 0] > 0).astype(int) + (X[:, 1] > 0.3).astype(int))
+    bst = lgb.train({**BASE, "objective": "multiclass", "num_class": 3},
+                    lgb.Dataset(X, label=y), num_boost_round=25)
+    p = bst.predict(X)
+    assert p.shape == (2000, 3)
+    np.testing.assert_allclose(p.sum(axis=1), 1.0, rtol=1e-4)
+    assert float(np.mean(np.argmax(p, 1) == y)) > 0.92
+
+
+def test_multiclassova(rng):
+    X = rng.randn(1500, 6)
+    y = ((X[:, 0] > 0).astype(int) + (X[:, 1] > 0.3).astype(int))
+    bst = lgb.train({**BASE, "objective": "multiclassova", "num_class": 3},
+                    lgb.Dataset(X, label=y), num_boost_round=25)
+    p = bst.predict(X)
+    assert float(np.mean(np.argmax(p, 1) == y)) > 0.9
+
+
+def test_cross_entropy(rng):
+    X = rng.randn(1500, 6)
+    y = 1.0 / (1.0 + np.exp(-(X[:, 0] - 0.5 * X[:, 1])))  # soft labels
+    bst = lgb.train({**BASE, "objective": "cross_entropy"},
+                    lgb.Dataset(X, label=y), num_boost_round=30)
+    p = bst.predict(X)
+    assert float(np.mean((p - y) ** 2)) < 0.01
+
+
+def test_lambdarank(rng):
+    n_q, per_q = 60, 20
+    n = n_q * per_q
+    X = rng.randn(n, 6)
+    rel = np.clip((X[:, 0] + 0.5 * X[:, 1] + 0.3 * rng.randn(n)) * 1.2 + 1.5,
+                  0, 4).astype(int)
+    group = np.full(n_q, per_q)
+    ds = lgb.Dataset(X, label=rel, group=group)
+    bst = lgb.train({**BASE, "objective": "lambdarank", "metric": ["ndcg"],
+                     "eval_at": [5]}, ds, num_boost_round=30)
+    res = {m: v for _, m, v, _ in bst.eval_train()}
+    assert res["ndcg@5"] > 0.85
+
+
+def test_rank_xendcg(rng):
+    n_q, per_q = 60, 20
+    n = n_q * per_q
+    X = rng.randn(n, 6)
+    rel = np.clip((X[:, 0] + 0.5 * X[:, 1]) * 1.2 + 1.5, 0, 4).astype(int)
+    ds = lgb.Dataset(X, label=rel, group=np.full(n_q, per_q))
+    bst = lgb.train({**BASE, "objective": "rank_xendcg", "metric": ["ndcg"],
+                     "eval_at": [5]}, ds, num_boost_round=30)
+    res = {m: v for _, m, v, _ in bst.eval_train()}
+    assert res["ndcg@5"] > 0.85
+
+
+def test_model_io_roundtrip(tmp_path, rng):
+    X, y = _reg_data(rng)
+    bst = lgb.train({**BASE, "objective": "regression"},
+                    lgb.Dataset(X, label=y), num_boost_round=10)
+    p1 = bst.predict(X)
+    path = str(tmp_path / "model.txt")
+    bst.save_model(path)
+    bst2 = lgb.Booster(model_file=path)
+    p2 = bst2.predict(X, raw_score=True)
+    np.testing.assert_allclose(p1, p2, rtol=1e-6)
+
+
+def test_early_stopping(rng):
+    X, y = _bin_data(rng, 3000)
+    Xtr, ytr, Xv, yv = X[:2000], y[:2000], X[2000:], y[2000:]
+    tr = lgb.Dataset(Xtr, label=ytr)
+    ev = tr.create_valid(Xv, label=yv)
+    bst = lgb.train({**BASE, "objective": "binary", "metric": ["binary_logloss"],
+                     "early_stopping_round": 5},
+                    tr, num_boost_round=500, valid_sets=[ev])
+    assert bst.best_iteration < 500
+    assert bst.inner.iter_ <= bst.best_iteration + 5 + 1
+
+
+def test_bagging_and_feature_fraction(rng):
+    X, y = _reg_data(rng)
+    bst = lgb.train({**BASE, "objective": "regression", "bagging_fraction": 0.6,
+                     "bagging_freq": 1, "feature_fraction": 0.7},
+                    lgb.Dataset(X, label=y), num_boost_round=30)
+    mse = float(np.mean((bst.predict(X) - y) ** 2))
+    assert mse < 0.2 * float(np.var(y))
+
+
+def test_goss(rng):
+    X, y = _reg_data(rng, n=3000)
+    bst = lgb.train({**BASE, "objective": "regression",
+                     "data_sample_strategy": "goss", "learning_rate": 0.1},
+                    lgb.Dataset(X, label=y), num_boost_round=40)
+    mse = float(np.mean((bst.predict(X) - y) ** 2))
+    assert mse < 0.15 * float(np.var(y))
+
+
+def test_dart(rng):
+    X, y = _reg_data(rng)
+    bst = lgb.train({**BASE, "objective": "regression", "boosting": "dart",
+                     "drop_rate": 0.2},
+                    lgb.Dataset(X, label=y), num_boost_round=25)
+    mse = float(np.mean((bst.predict(X) - y) ** 2))
+    assert mse < 0.35 * float(np.var(y))
+
+
+def test_rf(rng):
+    X, y = _bin_data(rng)
+    bst = lgb.train({**BASE, "objective": "binary", "boosting": "rf",
+                     "bagging_fraction": 0.7, "bagging_freq": 1},
+                    lgb.Dataset(X, label=y), num_boost_round=20)
+    p = bst.predict(X)
+    assert float(np.mean((p > 0.5) == y)) > 0.9
+
+
+def test_categorical_feature(rng):
+    n = 2000
+    cat = rng.randint(0, 8, n)
+    effect = np.asarray([3.0, -2.0, 1.0, -1.0, 2.5, 0.0, -3.0, 0.5])[cat]
+    X = np.column_stack([cat.astype(float), rng.randn(n, 3)])
+    y = effect + X[:, 1] + 0.05 * rng.randn(n)
+    ds = lgb.Dataset(X, label=y, categorical_feature=[0],
+                     params={"min_data_per_group": 5})
+    bst = lgb.train({**BASE, "objective": "regression", "min_data_per_group": 5,
+                     "cat_smooth": 1.0, "cat_l2": 1.0},
+                    ds, num_boost_round=40)
+    mse = float(np.mean((bst.predict(X) - y) ** 2))
+    assert mse < 0.1 * float(np.var(y))
+
+
+def test_monotone_constraints(rng):
+    n = 2000
+    X = rng.rand(n, 2)
+    y = 2 * X[:, 0] + 0.3 * np.sin(8 * X[:, 1]) + 0.05 * rng.randn(n)
+    bst = lgb.train({**BASE, "objective": "regression",
+                     "monotone_constraints": [1, 0]},
+                    lgb.Dataset(X, label=y), num_boost_round=30)
+    # predictions must be non-decreasing along feature 0
+    grid = np.linspace(0.01, 0.99, 50)
+    for x1 in (0.2, 0.8):
+        pts = np.column_stack([grid, np.full(50, x1)])
+        p = bst.predict(pts)
+        assert np.all(np.diff(p) >= -1e-6)
+
+
+def test_weights(rng):
+    X, y = _reg_data(rng)
+    w = np.where(X[:, 0] > 0, 10.0, 0.1)
+    bst = lgb.train({**BASE, "objective": "regression"},
+                    lgb.Dataset(X, label=y, weight=w), num_boost_round=30)
+    err = (bst.predict(X) - y) ** 2
+    assert err[X[:, 0] > 0].mean() < err[X[:, 0] <= 0].mean()
+
+
+def test_cv(rng):
+    X, y = _bin_data(rng)
+    res = lgb.cv({**BASE, "objective": "binary", "metric": ["auc"]},
+                 lgb.Dataset(X, label=y), num_boost_round=10, nfold=3)
+    assert "valid auc-mean" in res
+    assert res["valid auc-mean"][0] > 0.9
+
+
+def test_feature_importance(rng):
+    X, y = _reg_data(rng)
+    bst = lgb.train({**BASE, "objective": "regression"},
+                    lgb.Dataset(X, label=y), num_boost_round=10)
+    imp = bst.feature_importance()
+    assert imp.shape == (X.shape[1],)
+    assert imp[0] == imp.max()  # feature 0 dominates the target
+
+
+def test_continued_training(rng):
+    X, y = _reg_data(rng)
+    ds = lgb.Dataset(X, label=y)
+    bst1 = lgb.train({**BASE, "objective": "regression"}, ds, num_boost_round=10)
+    mse1 = float(np.mean((bst1.predict(X) - y) ** 2))
+    bst2 = lgb.train({**BASE, "objective": "regression"}, ds,
+                     num_boost_round=10, init_model=bst1)
+    assert bst2.num_trees() == 20
+    mse2 = float(np.mean((bst2.predict(X) - y) ** 2))
+    assert mse2 < mse1
+
+
+def test_custom_objective(rng):
+    X, y = _reg_data(rng)
+    ds = lgb.Dataset(X, label=y)
+
+    def fobj(score, _ds):
+        return score - y, np.ones_like(y)
+
+    bst = lgb.train({**BASE}, ds, num_boost_round=30, fobj=fobj)
+    pred = bst.predict(X, raw_score=True)
+    assert float(np.mean((pred - y) ** 2)) < 0.15 * float(np.var(y))
+
+
+def test_predict_leaf_index(rng):
+    X, y = _reg_data(rng)
+    bst = lgb.train({**BASE, "objective": "regression"},
+                    lgb.Dataset(X, label=y), num_boost_round=5)
+    leaves = bst.predict(X, pred_leaf=True)
+    assert leaves.shape == (len(X), 5)
+    assert leaves.max() < 15
+
+
+def test_pred_contrib_sums_to_prediction(rng):
+    X, y = _reg_data(rng, n=300)
+    bst = lgb.train({**BASE, "objective": "regression"},
+                    lgb.Dataset(X, label=y), num_boost_round=5)
+    contrib = bst.predict(X[:20], pred_contrib=True)
+    raw = bst.predict(X[:20], raw_score=True)
+    np.testing.assert_allclose(contrib.sum(axis=1), raw, rtol=1e-4, atol=1e-4)
