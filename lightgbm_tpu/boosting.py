@@ -163,7 +163,13 @@ class GBDT:
                         cat_of_bin[b] = mapper.categories[b]
                     go_left = np.isin(cat_of_bin[bvals], cats)
                 else:
-                    thr_bin = int(tree.split_bin[nd]) if hasattr(tree, "split_bin") else 0
+                    # derive the threshold bin from the real-valued threshold
+                    # so text-loaded models (which carry no bin ids) route
+                    # identically (analog of ValueToBin, bin.h:464)
+                    thr = float(tree.threshold[nd])
+                    thr_bin = int(np.searchsorted(mapper.upper_bounds, thr,
+                                                  side="left"))
+                    thr_bin = min(thr_bin, mapper.num_bins - 1)
                     go_left = bvals <= thr_bin
                     if mapper.missing_type == 2:
                         dl = bool(tree.decision_type[nd] & 2)
@@ -261,7 +267,7 @@ class GBDT:
             log = self.learner.train(ghc, fmask, key)
             tree = self._finalize_tree(log, k)
             self.models.append(tree)
-            if tree.num_leaves > 1 or abs(tree.leaf_value[0]) > 0:
+            if tree.num_leaves > 1:
                 any_nonconstant = True
         self.iter_ += 1
         return not any_nonconstant
@@ -270,32 +276,65 @@ class GBDT:
         return float(self.config.learning_rate)
 
     def _finalize_tree(self, log: TreeLog, class_id: int) -> Tree:
-        tree = self.learner.log_to_tree(log)
-        # objective-specific leaf renewal (reference:
-        # serial_tree_learner.cpp:684 RenewTreeOutput)
-        if self.objective.need_renew and tree.num_leaves > 1:
-            assign = np.asarray(log.row_leaf)
-            score_before = self.train_score.np()
-            renewed = self.objective.renew_leaf_values(
-                assign, tree.num_leaves, score_before)
-            if renewed is not None:
-                tree.leaf_value = renewed.astype(np.float64)
         rate = self._shrinkage_rate(log)
-        tree.apply_shrinkage(rate)
+        if self.objective.need_renew:
+            # objective-specific leaf renewal needs host stats (reference:
+            # serial_tree_learner.cpp:684 RenewTreeOutput) — slow path
+            tree = self.learner.log_to_tree(log)
+            if tree.num_leaves > 1:
+                assign = np.asarray(log.row_leaf)
+                score_before = self.train_score.np()
+                renewed = self.objective.renew_leaf_values(
+                    assign, tree.num_leaves, score_before)
+                if renewed is not None:
+                    tree.leaf_value = renewed.astype(np.float64)
+            tree.apply_shrinkage(rate)
+            leaf_vals_dev = jnp.asarray(tree.leaf_value, jnp.float32)
+        else:
+            # fast path: score updates run fully on device from the log;
+            # host Tree construction is a single batched transfer after
+            leaf_vals_dev = log.leaf_value * jnp.float32(rate)
+            tree = self.learner.log_to_tree(log)
+            tree.apply_shrinkage(rate)
         # score updates: train via the partition the learner already holds
-        # (reference: score_updater.hpp:88), valid via device routing
-        self.train_score.add(tree.leaf_value, log.row_leaf, class_id,
-                             self.num_tree_per_iteration)
-        for _, vset, vscore in self.valid_sets:
-            vbins = self._valid_bins(vset)
-            vleaf = assign_leaves(vbins, log)
-            vscore.add(tree.leaf_value, vleaf, class_id, self.num_tree_per_iteration)
+        # (reference: score_updater.hpp:88), valid via device routing.
+        # Constant (1-leaf) trees contribute nothing (reference:
+        # gbdt.cpp TrainOneIter skips UpdateScore when no split was found).
+        if tree.num_leaves > 1:
+            self.train_score.add(leaf_vals_dev, log.row_leaf, class_id,
+                                 self.num_tree_per_iteration)
+            for _, vset, vscore in self.valid_sets:
+                vbins = self._valid_bins(vset)
+                vleaf = assign_leaves(vbins, log)
+                vscore.add(leaf_vals_dev, vleaf, class_id,
+                           self.num_tree_per_iteration)
         return tree
 
     def _valid_bins(self, vset: BinnedDataset) -> jax.Array:
         if not hasattr(vset, "_device_bins"):
             vset._device_bins = jnp.asarray(vset.binned)
         return vset._device_bins
+
+    # ---------------------------------------------------------- fused blocks
+    def supports_fused(self) -> bool:
+        """True when K iterations can run as one device launch (no per-iter
+        host observation needed): plain GBDT, built-in objective without
+        leaf renewal, no valid sets, single-device learner."""
+        from .parallel.mesh import DataParallelTreeLearner
+        return (type(self) is GBDT
+                and self.objective is not None
+                and self.objective.name != "none"
+                and not self.objective.need_renew
+                and not self.valid_sets
+                and self.train_set is not None
+                and not isinstance(self.learner, DataParallelTreeLearner))
+
+    def train_block(self, k: int) -> bool:
+        """Train k iterations fused in one launch (see fused.py)."""
+        if getattr(self, "_fused", None) is None:
+            from .fused import FusedTrainer
+            self._fused = FusedTrainer(self)
+        return self._fused.run(k)
 
     def rollback_one_iter(self) -> None:
         """(reference: gbdt.cpp:454 RollbackOneIter)"""

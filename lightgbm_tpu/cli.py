@@ -1,0 +1,150 @@
+"""Command-line application: train / predict / convert_model / refit /
+save_binary.
+
+Equivalent of the reference CLI (reference: src/main.cpp:11,
+src/application/application.h:29 Application, application.cpp:52
+LoadParameters). Usage mirrors the reference:
+
+    python -m lightgbm_tpu config=train.conf [key=value ...]
+"""
+from __future__ import annotations
+
+import sys
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .basic import Booster, Dataset
+from .config import Config, resolve_aliases
+from .engine import train as _train
+from .io import load_config_file, load_text_file
+from .utils.log import Log, verbosity_to_level
+
+
+def parse_args(argv: List[str]) -> Dict[str, Any]:
+    """``config=file`` + ``key=value`` overrides
+    (reference: application.cpp:52-85 — config file first, CLI wins)."""
+    cli: Dict[str, str] = {}
+    for a in argv:
+        if "=" not in a:
+            Log.warning("Unknown argument: %s", a)
+            continue
+        k, v = a.split("=", 1)
+        cli[k.strip()] = v.strip()
+    params: Dict[str, Any] = {}
+    if "config" in cli or "config_file" in cli:
+        params.update(load_config_file(cli.get("config") or cli["config_file"]))
+    params.update(cli)
+    params.pop("config", None)
+    params.pop("config_file", None)
+    return params
+
+
+class Application:
+    """(reference: application.h:29)"""
+
+    def __init__(self, params: Dict[str, Any]) -> None:
+        self.raw_params = resolve_aliases(params)
+        self.config = Config.from_params(params)
+        Log.reset_log_level(verbosity_to_level(self.config.verbosity))
+
+    def run(self) -> None:
+        task = self.config.task
+        if task == "train":
+            self.train()
+        elif task in ("predict", "prediction", "test"):
+            self.predict()
+        elif task == "convert_model":
+            self.convert_model()
+        elif task == "refit":
+            self.refit()
+        elif task == "save_binary":
+            self.save_binary()
+        else:
+            Log.fatal("Unknown task: %s", task)
+
+    def _load_train_data(self) -> Dataset:
+        cfg = self.config
+        X, label, weight, group, names = load_text_file(cfg.data, cfg)
+        return Dataset(X, label=label, weight=weight, group=group,
+                       feature_name=names or "auto",
+                       params=dict(self.raw_params))
+
+    def train(self) -> None:
+        cfg = self.config
+        train_set = self._load_train_data()
+        valid_sets, valid_names = [], []
+        for i, vf in enumerate(cfg.valid):
+            Xv, lv, wv, gv, _ = load_text_file(vf, cfg)
+            valid_sets.append(train_set.create_valid(Xv, label=lv, weight=wv,
+                                                     group=gv))
+            valid_names.append("valid_%d" % (i + 1) if len(cfg.valid) > 1
+                               else "valid_1")
+        params = dict(self.raw_params)
+        params.setdefault("is_provide_training_metric",
+                          cfg.is_provide_training_metric)
+        if cfg.is_provide_training_metric:
+            valid_sets.insert(0, train_set)
+            valid_names.insert(0, "training")
+        init_model = cfg.input_model or None
+        bst = _train(params, train_set, num_boost_round=cfg.num_iterations,
+                     valid_sets=valid_sets, valid_names=valid_names,
+                     init_model=init_model)
+        bst.save_model(cfg.output_model)
+        Log.info("Finished training; model saved to %s", cfg.output_model)
+
+    def predict(self) -> None:
+        cfg = self.config
+        if not cfg.input_model:
+            Log.fatal("task=predict requires input_model")
+        bst = Booster(model_file=cfg.input_model)
+        X, _, _, _, _ = load_text_file(cfg.data, cfg)
+        pred = bst.predict(
+            X, raw_score=cfg.predict_raw_score,
+            start_iteration=cfg.start_iteration_predict,
+            num_iteration=(cfg.num_iteration_predict
+                           if cfg.num_iteration_predict > 0 else None),
+            pred_leaf=cfg.predict_leaf_index, pred_contrib=cfg.predict_contrib)
+        pred2d = pred if pred.ndim > 1 else pred.reshape(-1, 1)
+        with open(cfg.output_result, "w") as f:
+            for row in pred2d:
+                f.write("\t".join("%g" % v for v in row) + "\n")
+        Log.info("Finished prediction; results saved to %s", cfg.output_result)
+
+    def convert_model(self) -> None:
+        cfg = self.config
+        if not cfg.input_model:
+            Log.fatal("task=convert_model requires input_model")
+        bst = Booster(model_file=cfg.input_model)
+        out = getattr(cfg, "convert_model_file", "") or "gbdt_prediction.json"
+        with open(out, "w") as f:
+            f.write(bst.inner.dump_json())
+        Log.info("Model dumped to %s", out)
+
+    def refit(self) -> None:
+        cfg = self.config
+        if not cfg.input_model:
+            Log.fatal("task=refit requires input_model")
+        bst = Booster(model_file=cfg.input_model)
+        X, label, _, _, _ = load_text_file(cfg.data, cfg)
+        new_bst = bst.refit(X, label, decay_rate=cfg.refit_decay_rate)
+        new_bst.save_model(cfg.output_model)
+        Log.info("Refit model saved to %s", cfg.output_model)
+
+    def save_binary(self) -> None:
+        cfg = self.config
+        ds = self._load_train_data()
+        ds.save_binary(cfg.data + ".bin")
+        Log.info("Saved binary dataset to %s.bin", cfg.data)
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv:
+        print(__doc__)
+        return
+    Application(parse_args(argv)).run()
+
+
+if __name__ == "__main__":
+    main()

@@ -207,6 +207,7 @@ class Config:
     tpu_hist_dtype: str = "float32"  # histogram accumulation dtype
     tpu_rows_per_chunk: int = 65536  # rows per device histogram chunk
     tpu_donate_buffers: bool = True
+    tpu_iter_block: int = 10         # boosting iterations fused per device launch
 
     # resolved, not user-set
     num_original_features: int = 0

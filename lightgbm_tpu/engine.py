@@ -65,14 +65,35 @@ def train(
                                         first_metric_only=bool(
                                             params.get("first_metric_only", False))))
     verbosity = int(params.get("verbosity", 1))
+    auto_callbacks = []
     if verbosity > 0 and not any(getattr(c, "order", None) == 10 for c in callbacks):
-        callbacks.append(log_evaluation(int(params.get("metric_freq", 1))))
+        auto_cb = log_evaluation(int(params.get("metric_freq", 1)))
+        auto_callbacks.append(auto_cb)
+        callbacks.append(auto_cb)
     callbacks_before = [c for c in callbacks if getattr(c, "before_iteration", False)]
     callbacks_after = [c for c in callbacks if not getattr(c, "before_iteration", False)]
     callbacks_before.sort(key=lambda c: getattr(c, "order", 0))
     callbacks_after.sort(key=lambda c: getattr(c, "order", 0))
 
     begin = booster.inner.iter_
+    # fused fast path: no per-iteration observation -> K iters per launch
+    # (only the engine's own log_evaluation is inert without valid sets;
+    # any user-supplied callback disables fusing)
+    user_callbacks = [c for c in callbacks if c not in auto_callbacks]
+    if (fobj is None and feval is None and not valid_sets
+            and not user_callbacks and booster.inner.supports_fused()):
+        block = max(1, int(params.get("tpu_iter_block", 10)))
+        end = begin + num_boost_round
+        while booster.inner.iter_ < end:
+            k = min(block, end - booster.inner.iter_)
+            if booster.inner.train_block(k):
+                Log.warning("Stopped training because there are no more leaves "
+                            "that meet the split requirements")
+                break
+        booster.best_iteration = booster.inner.iter_
+        booster.inner.best_iteration = booster.best_iteration
+        return booster
+
     for it in range(begin, begin + num_boost_round):
         for cb in callbacks_before:
             cb(CallbackEnv(booster, params, it, begin, begin + num_boost_round, None))

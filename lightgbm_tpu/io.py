@@ -1,0 +1,167 @@
+"""Text dataset loading: CSV / TSV / LibSVM with auto-detection.
+
+Equivalent of the reference's Parser + DatasetLoader text path (reference:
+src/io/parser.cpp Parser::CreateParser format auto-detect,
+src/io/dataset_loader.cpp:182 LoadFromFile) including label/weight/group
+column designation, ignore columns, header handling, and the sidecar
+``.query``/``.weight`` files the reference CLI reads
+(src/io/metadata.cpp LoadQueryBoundaries/LoadWeights).
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .config import Config
+from .utils.log import Log
+
+
+def detect_format(first_lines: List[str]) -> str:
+    """'csv' | 'tsv' | 'libsvm' (reference: parser.cpp DetermineDataType)."""
+    for line in first_lines:
+        line = line.strip()
+        if not line:
+            continue
+        tokens = line.replace("\t", " ").split()
+        if any(":" in t for t in tokens[1:]):
+            return "libsvm"
+        if "\t" in line:
+            return "tsv"
+        if "," in line:
+            return "csv"
+    return "tsv"
+
+
+def _parse_column_spec(spec: str, header_names: Optional[List[str]]) -> int:
+    """Column spec: int index or 'name:<col>' (reference: config docs
+    label_column)."""
+    if spec is None or spec == "":
+        return -1
+    if isinstance(spec, int):
+        return spec
+    s = str(spec)
+    if s.startswith("name:"):
+        name = s[5:]
+        if header_names and name in header_names:
+            return header_names.index(name)
+        Log.fatal("Column name '%s' not found in header", name)
+    return int(s)
+
+
+def load_text_file(
+    filename: str,
+    config: Config,
+) -> Tuple[np.ndarray, Optional[np.ndarray], Optional[np.ndarray],
+           Optional[np.ndarray], Optional[List[str]]]:
+    """Returns (X, label, weight, group_sizes, feature_names)."""
+    if not os.path.exists(filename):
+        Log.fatal("Data file %s does not exist", filename)
+    with open(filename) as f:
+        head = [f.readline() for _ in range(3)]
+    has_header = bool(config.header)
+    fmt = detect_format(head[1 if has_header else 0:])
+
+    header_names: Optional[List[str]] = None
+    skip = 0
+    if has_header:
+        sep = {"csv": ",", "tsv": "\t"}.get(fmt)
+        header_names = [c.strip() for c in head[0].strip().split(sep)] if sep else None
+        skip = 1
+
+    if fmt == "libsvm":
+        X, label = _load_libsvm(filename, skip)
+        weight = None
+        feature_names = None
+        label_idx = -1
+        used_cols = None
+    else:
+        sep = "," if fmt == "csv" else "\t"
+        raw = np.genfromtxt(filename, delimiter=sep, skip_header=skip,
+                            dtype=np.float64)
+        if raw.ndim == 1:
+            raw = raw.reshape(-1, 1)
+        ncol = raw.shape[1]
+        label_idx = _parse_column_spec(config.label_column or "0", header_names)
+        weight_idx = _parse_column_spec(config.weight_column, header_names)
+        group_idx = _parse_column_spec(config.group_column, header_names)
+        ignore: set = set()
+        if config.ignore_column:
+            for tok in str(config.ignore_column).split(","):
+                if tok:
+                    ignore.add(_parse_column_spec(tok, header_names))
+        special = {label_idx} | ignore
+        if weight_idx >= 0:
+            special.add(weight_idx)
+        if group_idx >= 0:
+            special.add(group_idx)
+        used_cols = [c for c in range(ncol) if c not in special]
+        X = raw[:, used_cols]
+        label = raw[:, label_idx] if 0 <= label_idx < ncol else None
+        weight = raw[:, weight_idx] if weight_idx >= 0 else None
+        feature_names = [header_names[c] for c in used_cols] if header_names else None
+        group_col = raw[:, group_idx] if group_idx >= 0 else None
+        if group_col is not None:
+            # run lengths in order of appearance: query ids need not be
+            # sorted, only contiguous (reference: metadata.cpp SetQuery)
+            gc = group_col.astype(np.int64)
+            change = np.flatnonzero(np.diff(gc)) + 1
+            bounds = np.concatenate([[0], change, [len(gc)]])
+            group = np.diff(bounds)
+        else:
+            group = None
+    if fmt == "libsvm":
+        group = None
+
+    # sidecar files (reference: metadata.cpp — "<data>.query"/".weight")
+    qfile = filename + ".query"
+    if group is None and os.path.exists(qfile):
+        group = np.loadtxt(qfile, dtype=np.int64).ravel()
+    wfile = filename + ".weight"
+    if weight is None and os.path.exists(wfile):
+        weight = np.loadtxt(wfile, dtype=np.float64).ravel()
+    return X, label, weight, group, feature_names
+
+
+def _load_libsvm(filename: str, skip: int) -> Tuple[np.ndarray, np.ndarray]:
+    labels: List[float] = []
+    rows: List[Dict[int, float]] = []
+    max_idx = -1
+    with open(filename) as f:
+        for i, line in enumerate(f):
+            if i < skip:
+                continue
+            line = line.strip()
+            if not line:
+                continue
+            toks = line.split()
+            labels.append(float(toks[0]))
+            row: Dict[int, float] = {}
+            for t in toks[1:]:
+                if ":" not in t:
+                    continue
+                k, v = t.split(":", 1)
+                idx = int(k)
+                row[idx] = float(v)
+                max_idx = max(max_idx, idx)
+            rows.append(row)
+    X = np.zeros((len(rows), max_idx + 1), dtype=np.float64)
+    for r, row in enumerate(rows):
+        for k, v in row.items():
+            X[r, k] = v
+    return X, np.asarray(labels)
+
+
+def load_config_file(path: str) -> Dict[str, str]:
+    """Parse a LightGBM-style config file: ``key = value`` lines, ``#``
+    comments (reference: application.cpp:52 LoadParameters)."""
+    out: Dict[str, str] = {}
+    with open(path) as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if not line or "=" not in line:
+                continue
+            k, v = line.split("=", 1)
+            out[k.strip()] = v.strip()
+    return out

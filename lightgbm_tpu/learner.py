@@ -41,6 +41,7 @@ from .ops.split import (
     find_best_split,
 )
 from .tree import Tree
+from .utils.log import Log
 
 
 class Comm:
@@ -109,13 +110,37 @@ def build_tree(
     extra_trees: bool = False,
     comm: Comm = Comm(),
     hist_chunk: int = 2048,
+    constraint_sets: Optional[jax.Array] = None,   # (S, F) bool, static presence
+    forced: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None,
+    # forced = (leaf (R,), feature (R,), bin (R,)) BFS-ordered forced splits
+    use_pallas: bool = False,
+    mxu_bf16: bool = False,
 ) -> TreeLog:
     """Grow one leaf-wise tree entirely on device. jit/shard_map once."""
     n, num_feat = bins.shape
     max_splits = num_leaves - 1
+    n_forced = 0 if forced is None else int(forced[0].shape[0])
 
-    def hist_of_mask(leaf_mask):
-        h = build_histogram(bins, ghc * leaf_mask[:, None], num_bin, hist_chunk)
+    def allowed_mask(used_row):
+        """Interaction constraints (reference: col_sampler.hpp:94 GetByNode):
+        a branch may only use features from constraint sets compatible with
+        the features already used on its path."""
+        if constraint_sets is None:
+            return jnp.ones((num_feat,), bool)
+        compat = jnp.all(~used_row[None, :] | constraint_sets, axis=1)  # (S,)
+        return jnp.any(constraint_sets & compat[:, None], axis=0)
+
+    def hist_of_leaf(row_leaf, leaf_id):
+        """Histogram of the rows currently on ``leaf_id`` (all rows when
+        leaf_id < 0). TPU: Pallas kernel with the leaf mask computed
+        in-kernel; elsewhere: masked one-hot matmul."""
+        if use_pallas:
+            from .ops.hist_pallas import hist_pallas
+            h = hist_pallas(bins, ghc, row_leaf, leaf_id, num_bin)
+        else:
+            mask = (jnp.asarray(leaf_id) < 0) | (row_leaf == leaf_id)
+            h = build_histogram(bins, ghc * mask[:, None].astype(jnp.float32),
+                                num_bin, hist_chunk, mxu_bf16=mxu_bf16)
         return comm.psum(h)
 
     def node_inputs(r, leaf):
@@ -135,8 +160,9 @@ def build_tree(
                 .astype(jnp.int32)
         return fmask, rand_thr
 
-    def best_for(r, leaf, hist, parent_sum, parent_out, lower, upper):
+    def best_for(r, leaf, hist, parent_sum, parent_out, lower, upper, used_row):
         fmask, rand_thr = node_inputs(r, leaf)
+        fmask = fmask & allowed_mask(used_row)
         return find_best_split(
             hist, parent_sum, meta, fmask, hp,
             parent_output=parent_out, leaf_lower=lower, leaf_upper=upper,
@@ -144,7 +170,7 @@ def build_tree(
 
     # ---- init: root ----
     root_sum = comm.psum(jnp.sum(ghc, axis=0))
-    root_hist = hist_of_mask(jnp.ones((n,), jnp.float32))
+    root_hist = hist_of_leaf(jnp.zeros((n,), jnp.int32), jnp.int32(-1))
     hist_pool = jnp.zeros((num_leaves, num_feat, num_bin, 3), jnp.float32)
     hist_pool = hist_pool.at[0].set(root_hist)
     leaf_sum = jnp.zeros((num_leaves, 3), jnp.float32).at[0].set(root_sum)
@@ -153,9 +179,11 @@ def build_tree(
     leaf_depth = jnp.zeros((num_leaves,), jnp.int32)
     leaf_lower = jnp.full((num_leaves,), -jnp.inf, jnp.float32)
     leaf_upper = jnp.full((num_leaves,), jnp.inf, jnp.float32)
+    leaf_used = jnp.zeros((num_leaves, num_feat), bool)
     best = _empty_best(num_leaves, num_bin)
     best = _set_best(best, 0, best_for(0, jnp.int32(0), root_hist, root_sum,
-                                       leaf_out[0], leaf_lower[0], leaf_upper[0]))
+                                       leaf_out[0], leaf_lower[0], leaf_upper[0],
+                                       leaf_used[0]))
     row_leaf = jnp.zeros((n,), jnp.int32)
     log = TreeLog(
         num_splits=jnp.int32(0),
@@ -178,19 +206,55 @@ def build_tree(
             return jnp.bool_(True)
         return depth < max_depth
 
+    force_live = jnp.bool_(n_forced > 0)
     carry0 = (jnp.int32(0), row_leaf, hist_pool, leaf_sum, leaf_out,
-              leaf_depth, leaf_lower, leaf_upper, best, log)
+              leaf_depth, leaf_lower, leaf_upper, best, log, leaf_used,
+              force_live)
 
     def cond(carry):
-        r, _, _, _, _, _, _, _, best, _ = carry
-        return (r < max_splits) & (jnp.max(best.gain) > 0.0)
+        r = carry[0]
+        best = carry[8]
+        log = carry[9]
+        force_live = carry[11]
+        forcing = force_live & (r < n_forced) if n_forced else False
+        return (log.num_splits < max_splits) & (r < max_splits + n_forced) \
+            & ((jnp.max(best.gain) > 0.0) | forcing)
 
     def body(carry):
         (r, row_leaf, hist_pool, leaf_sum, leaf_out, leaf_depth,
-         leaf_lower, leaf_upper, best, log) = carry
+         leaf_lower, leaf_upper, best, log, leaf_used, force_live) = carry
         leaf = jnp.argmax(best.gain).astype(jnp.int32)
         info: SplitInfo = jax.tree.map(lambda a: a[leaf], best)
-        new_leaf = r + 1
+        if n_forced:
+            # forced splits (reference: serial_tree_learner.cpp:450
+            # ForceSplits — BFS-ordered (leaf, feature, bin) applied before
+            # gain-driven growth; an invalid forced split aborts forcing)
+            f_leaf, f_feat, f_bin = forced
+
+            def pick_forced(_):
+                ri = jnp.minimum(r, n_forced - 1)
+                fl = f_leaf[ri]
+                fi = find_best_split(
+                    hist_pool[fl], leaf_sum[fl], meta,
+                    jnp.arange(num_feat) == f_feat[ri], hp,
+                    parent_output=leaf_out[fl], leaf_lower=leaf_lower[fl],
+                    leaf_upper=leaf_upper[fl],
+                    rand_threshold=jnp.full((num_feat,), f_bin[ri], jnp.int32))
+                ok = fi.gain > -jnp.inf
+                return (jnp.where(ok, fl, leaf),
+                        jax.tree.map(lambda a, b: jnp.where(ok, a, b), fi, info),
+                        ok)
+
+            use_forced = force_live & (r < n_forced)
+            leaf, info, force_live = jax.lax.cond(
+                use_forced, pick_forced,
+                lambda _: (leaf, info, jnp.bool_(False)), operand=None)
+        valid = info.gain > -jnp.inf
+        s = log.num_splits
+        new_leaf = s + 1
+
+        prev = (row_leaf, hist_pool, leaf_sum, leaf_out, leaf_depth,
+                leaf_lower, leaf_upper, best, log, leaf_used)
 
         # ---- apply split to the row partition (DataPartition::Split analog) ----
         bins_col = jnp.take(bins, info.feature, axis=1).astype(jnp.int32)
@@ -201,15 +265,15 @@ def build_tree(
         # ---- record ----
         log = log._replace(
             num_splits=new_leaf,
-            split_leaf=log.split_leaf.at[r].set(leaf),
-            feature=log.feature.at[r].set(info.feature),
-            bin=log.bin.at[r].set(info.bin),
-            kind=log.kind.at[r].set(info.kind),
-            default_left=log.default_left.at[r].set(info.default_left),
-            gain=log.gain.at[r].set(info.gain),
-            left_sum=log.left_sum.at[r].set(info.left_sum),
-            right_sum=log.right_sum.at[r].set(info.right_sum),
-            go_left=log.go_left.at[r].set(info.go_left),
+            split_leaf=log.split_leaf.at[s].set(leaf),
+            feature=log.feature.at[s].set(info.feature),
+            bin=log.bin.at[s].set(info.bin),
+            kind=log.kind.at[s].set(info.kind),
+            default_left=log.default_left.at[s].set(info.default_left),
+            gain=log.gain.at[s].set(info.gain),
+            left_sum=log.left_sum.at[s].set(info.left_sum),
+            right_sum=log.right_sum.at[s].set(info.right_sum),
+            go_left=log.go_left.at[s].set(info.go_left),
         )
 
         # ---- stats bookkeeping ----
@@ -233,7 +297,7 @@ def build_tree(
         # larger (serial_tree_learner.cpp:418) ----
         left_smaller = info.left_sum[2] <= info.right_sum[2]
         small_id = jnp.where(left_smaller, leaf, new_leaf)
-        hist_small = hist_of_mask((row_leaf == small_id).astype(jnp.float32))
+        hist_small = hist_of_leaf(row_leaf, small_id)
         parent_hist = hist_pool[leaf]
         hist_large = parent_hist - hist_small
         hist_left = jnp.where(left_smaller, hist_small, hist_large)
@@ -241,21 +305,32 @@ def build_tree(
         hist_pool = hist_pool.at[leaf].set(hist_left).at[new_leaf].set(hist_right)
 
         # ---- refresh best splits for the two children ----
+        # interaction-constraint bookkeeping: children inherit path features
+        used_new = leaf_used[leaf].at[info.feature].set(True)
+        leaf_used = leaf_used.at[leaf].set(used_new).at[new_leaf].set(used_new)
+
         info_l = best_for(r, leaf, hist_left, info.left_sum,
-                          leaf_out[leaf], leaf_lower[leaf], leaf_upper[leaf])
+                          leaf_out[leaf], leaf_lower[leaf], leaf_upper[leaf],
+                          used_new)
         info_r = best_for(r, new_leaf, hist_right, info.right_sum,
-                          leaf_out[new_leaf], leaf_lower[new_leaf], leaf_upper[new_leaf])
+                          leaf_out[new_leaf], leaf_lower[new_leaf],
+                          leaf_upper[new_leaf], used_new)
         gate_l = depth_ok(leaf_depth[leaf])
         gate_r = depth_ok(leaf_depth[new_leaf])
         info_l = info_l._replace(gain=jnp.where(gate_l, info_l.gain, -jnp.inf))
         info_r = info_r._replace(gain=jnp.where(gate_r, info_r.gain, -jnp.inf))
         best = _set_best(best, leaf, info_l)
         best = _set_best(best, new_leaf, info_r)
-        return (new_leaf, row_leaf, hist_pool, leaf_sum, leaf_out,
-                leaf_depth, leaf_lower, leaf_upper, best, log)
+
+        new = (row_leaf, hist_pool, leaf_sum, leaf_out, leaf_depth,
+               leaf_lower, leaf_upper, best, log, leaf_used)
+        # an invalid round (forced split impossible and no positive-gain
+        # split) advances the round counter but commits nothing
+        committed = jax.tree.map(lambda a, b: jnp.where(valid, a, b), new, prev)
+        return (r + 1,) + committed + (force_live,)
 
     carry = jax.lax.while_loop(cond, body, carry0)
-    (_, row_leaf, _, leaf_sum, leaf_out, _, _, _, _, log) = carry
+    (_, row_leaf, _, leaf_sum, leaf_out, _, _, _, _, log, _, _) = carry
     return log._replace(leaf_value=leaf_out, leaf_sum=leaf_sum, row_leaf=row_leaf)
 
 
@@ -277,6 +352,16 @@ def assign_leaves(bins: jax.Array, log: TreeLog) -> jax.Array:
         return jnp.where(active, upd, row_leaf)
 
     return jax.lax.fori_loop(0, max_splits, body, row_leaf)
+
+
+def _use_pallas(num_bin: int) -> bool:
+    import os
+    # the Pallas kernel is currently VPU-bound and loses to the bandwidth-
+    # bound einsum path on v5e; opt in while it is being tuned
+    if not os.environ.get("LGB_TPU_ENABLE_PALLAS"):
+        return False
+    from .ops.hist_pallas import pallas_available
+    return pallas_available(num_bin)
 
 
 # --------------------------------------------------------------------------
@@ -333,8 +418,13 @@ class SerialTreeLearner:
         )
         self.bins = jnp.asarray(dataset.binned)
         self.comm = Comm(comm_axis)
-        self._build = jax.jit(partial(
-            build_tree,
+        self._build = jax.jit(partial(build_tree, **self.build_kwargs()))
+
+    def build_kwargs(self) -> dict:
+        """Static arguments shared by the serial, data-parallel and fused
+        builders."""
+        config = self.config
+        return dict(
             hp=self.hp,
             num_leaves=self.num_leaves,
             num_bin=self.num_bin,
@@ -343,27 +433,99 @@ class SerialTreeLearner:
             extra_trees=bool(config.extra_trees),
             comm=self.comm,
             hist_chunk=min(int(config.tpu_rows_per_chunk), 8192),
-        ))
+            constraint_sets=self._constraint_sets(),
+            forced=self._forced_splits(),
+            use_pallas=_use_pallas(self.num_bin),
+            # measured on v5e: XLA fuses the f32 HIGHEST one-hot matmul better
+            # than the bf16 hi/lo two-dot variant (see ops/histogram.py)
+            mxu_bf16=False,
+        )
+
+    def _constraint_sets(self) -> Optional[jax.Array]:
+        """Parse interaction_constraints "[0,1],[2,3]" into (S, F) bool over
+        inner feature indices (reference: col_sampler.hpp:27)."""
+        spec = self.config.interaction_constraints
+        if not spec:
+            return None
+        import re
+        groups = re.findall(r"\[([^\]]*)\]", str(spec))
+        if not groups:
+            return None
+        F = self.dataset.num_features
+        sets = np.zeros((len(groups), F), dtype=bool)
+        for s, grp in enumerate(groups):
+            for tok in grp.split(","):
+                tok = tok.strip()
+                if tok == "":
+                    continue
+                inner = self.dataset.inner_feature_index(int(tok))
+                if inner >= 0:
+                    sets[s, inner] = True
+        return jnp.asarray(sets)
+
+    def _forced_splits(self):
+        """Load forcedsplits_filename JSON into BFS (leaf, feature, bin)
+        arrays (reference: serial_tree_learner.cpp:450 ForceSplits)."""
+        fname = self.config.forcedsplits_filename
+        if not fname:
+            return None
+        import json as _json
+        import os
+        if not os.path.exists(fname):
+            Log.warning("forced splits file %s not found", fname)
+            return None
+        with open(fname) as f:
+            root = _json.load(f)
+        leaves, feats, bins_ = [], [], []
+        queue = [(root, 0)]
+        n_created = 0
+        while queue and n_created < self.num_leaves - 1:
+            node, leaf = queue.pop(0)
+            if not node or "feature" not in node:
+                continue
+            inner = self.dataset.inner_feature_index(int(node["feature"]))
+            if inner < 0:
+                continue
+            mapper = self.dataset.bin_mappers[inner]
+            tbin = int(mapper.value_to_bin(
+                np.asarray([float(node["threshold"])]))[0])
+            tbin = min(tbin, mapper.num_bins - 2) if mapper.num_bins > 1 else 0
+            leaves.append(leaf)
+            feats.append(inner)
+            bins_.append(tbin)
+            n_created += 1
+            new_leaf = n_created
+            if "left" in node and node["left"]:
+                queue.append((node["left"], leaf))
+            if "right" in node and node["right"]:
+                queue.append((node["right"], new_leaf))
+        if not leaves:
+            return None
+        return (jnp.asarray(leaves, jnp.int32), jnp.asarray(feats, jnp.int32),
+                jnp.asarray(bins_, jnp.int32))
 
     def train(self, ghc: jax.Array, feature_mask: jax.Array, key: jax.Array) -> TreeLog:
         """One tree from (grad, hess, inbag) channels. Returns the device log."""
         return self._build(self.bins, ghc, self.meta, feature_mask, key)
 
     def log_to_tree(self, log: TreeLog) -> Tree:
-        """Pull the split log to host and rebuild the Tree model."""
-        num_splits = int(log.num_splits)
+        """Pull the split log to host and rebuild the Tree model.
+
+        One batched transfer: per-field np.asarray would cost a blocking
+        device->host round-trip each (~15x the latency over a TPU tunnel).
+        ``row_leaf`` (O(rows)) stays on device — only O(leaves) data moves.
+        """
+        (num_splits, split_leaf, feature, bin_, default_left, gain, left_sum,
+         right_sum, leaf_value, kind, go_left) = jax.device_get(
+            (log.num_splits, log.split_leaf, log.feature, log.bin,
+             log.default_left, log.gain, log.left_sum, log.right_sum,
+             log.leaf_value, log.kind, log.go_left))
         return Tree.from_split_log(
-            num_splits,
-            np.asarray(log.split_leaf),
-            np.asarray(log.feature),
-            np.asarray(log.bin),
-            np.asarray(log.default_left),
-            np.asarray(log.gain),
-            np.asarray(log.left_sum),
-            np.asarray(log.right_sum),
-            np.asarray(log.leaf_value),
+            int(num_splits),
+            split_leaf, feature, bin_, default_left, gain, left_sum, right_sum,
+            leaf_value,
             bin_mappers=self.dataset.bin_mappers,
             real_feature_index=self.dataset.used_feature_indices,
-            go_left_table=np.asarray(log.go_left),
-            is_categorical=np.asarray(log.kind) > 0,
+            go_left_table=go_left,
+            is_categorical=kind > 0,
         )

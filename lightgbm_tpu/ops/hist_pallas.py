@@ -1,0 +1,132 @@
+"""Pallas TPU histogram kernel.
+
+The make-or-break op (SURVEY.md §7 "Scatter-add histogram throughput on
+TPU"; reference hot loop: src/io/dense_bin.hpp:98 ConstructHistogramInner and
+the GPU kernels src/treelearner/ocl/histogram256.cl).
+
+The XLA fallback (ops/histogram.py) materializes the (chunk, F*B) one-hot in
+HBM — ~B bytes of traffic per (row, feature) cell. This kernel builds the
+one-hot tile in VMEM only, leaving HBM traffic at the information-theoretic
+floor: one int8 read per (row, feature) cell per bin-block, plus the
+(g,h,cnt) channels. The per-leaf row mask is computed in-kernel from
+``row_leaf`` so no masked copy of the gradient channels is ever written.
+
+Tiling: grid (bin_blocks, row_chunks). Each step loads a (C, F) slab of the
+binned matrix and accumulates the one-hot x channels matmul for a BB-wide
+range of bins; row chunks iterate innermost, revisiting (and accumulating
+into) the same output block. One-hot lanes use pltpu.repeat's tile layout:
+lane l -> (bin = l // F, feature = l % F). All comparisons run in bfloat16
+(bin ids <= 255 are exact) and the f32 channels are split hi+lo bf16 so two
+MXU passes reproduce f32 accuracy.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+C_PAD = 8                      # channel count padded to the f32 sublane tile
+LANE_TARGET = 2048             # one-hot lanes per grid step
+VMEM_BUDGET = 6 * 1024 * 1024  # bytes for the in-flight one-hot working set
+MAX_PALLAS_BINS = 256          # bf16 integer-exactness bound
+
+
+def _kernel(leaf_ref, bins_ref, ghc_ref, row_leaf_ref, lane_bin_ref, out_ref,
+            *, bb, fg):
+    i = pl.program_id(1)       # row chunk
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    bins_blk = bins_ref[:]                         # (C, F) int8/16
+    ghc_blk = ghc_ref[:]                           # (C, C_PAD) f32
+    leaf = leaf_ref[0]
+    mask = jnp.logical_or(leaf < 0, row_leaf_ref[:] == leaf)   # (C, 1)
+    ghcm = ghc_blk * mask.astype(jnp.float32)
+
+    # arithmetic one-hot, all bfloat16 (integers <= 256 exact): for integer
+    # d = bin - lane_bin, relu(1 - d^2) is exactly the indicator d == 0.
+    # Avoids int32 tiles and vector compares the target cannot lower.
+    rep = pltpu.repeat(bins_blk.astype(jnp.int32).astype(jnp.bfloat16),
+                       bb, axis=1)                 # (C, bb*F)
+    d = rep - lane_bin_ref[0, 0:1, :]              # (C, bb*F) - (1, bb*F)
+    oh = jnp.maximum(jnp.bfloat16(1.0) - d * d, jnp.bfloat16(0.0))
+
+    hi = ghcm.astype(jnp.bfloat16)
+    lo = (ghcm - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    acc = jax.lax.dot(hi.T, oh, preferred_element_type=jnp.float32)
+    acc = acc + jax.lax.dot(lo.T, oh, preferred_element_type=jnp.float32)
+    out_ref[:] += acc                               # (C_PAD, bb*F)
+
+
+@functools.partial(jax.jit, static_argnames=("num_bins",))
+def hist_pallas(bins, ghc, row_leaf, leaf, num_bins: int):
+    """(N, F) int bins + (N, C) f32 channels + (N,) row_leaf + scalar leaf
+    -> (F, num_bins, C) f32 histogram of rows on ``leaf`` (all rows when
+    leaf < 0)."""
+    n, num_feat = bins.shape
+    c = ghc.shape[1]
+    # lane count bb*f_pad must be 128-divisible: pad features to a multiple
+    # of 32 and use bin-blocks in multiples of 4
+    f_pad_to = ((num_feat + 31) // 32) * 32
+    bb = max(4, (min(num_bins + 3, LANE_TARGET // f_pad_to) // 4) * 4)
+    b_pad = ((num_bins + bb - 1) // bb) * bb
+    n_bb = b_pad // bb
+    lanes = bb * f_pad_to
+    # ~5 bytes per (row, lane) cell: bf16 repeat tile + bf16 one-hot + slack
+    row_chunk = max(8, min(1024, (VMEM_BUDGET // (lanes * 5)) // 8 * 8))
+    r_pad = (-n) % row_chunk
+    if f_pad_to != num_feat:
+        bins = jnp.pad(bins, ((0, 0), (0, f_pad_to - num_feat)))
+
+    row_leaf2d = row_leaf.astype(jnp.int32).reshape(-1, 1)
+    if r_pad:
+        bins = jnp.pad(bins, ((0, r_pad), (0, 0)))
+        ghc = jnp.pad(ghc, ((0, r_pad), (0, 0)))
+        # padded rows: never match any leaf; zero channels cover the root pass
+        row_leaf2d = jnp.pad(row_leaf2d, ((0, r_pad), (0, 0)),
+                             constant_values=-2)
+    if c < C_PAD:
+        ghc = jnp.pad(ghc, ((0, 0), (0, C_PAD - c)))
+    n_pad = bins.shape[0]
+    n_rc = n_pad // row_chunk
+    leaf_arr = jnp.asarray([leaf], jnp.int32)
+    # precomputed lane -> bin id table, bf16; sublane dim padded to 8 to
+    # satisfy block-shape tiling
+    lb = (np.arange(b_pad * f_pad_to) // f_pad_to).reshape(n_bb, 1, lanes)
+    lane_bin = jnp.asarray(np.broadcast_to(lb, (n_bb, 8, lanes))
+                           .astype(np.float32), jnp.bfloat16)
+
+    out = pl.pallas_call(
+        functools.partial(_kernel, bb=bb, fg=f_pad_to),
+        grid=(n_bb, n_rc),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((row_chunk, f_pad_to), lambda j, i: (i, 0)),
+            pl.BlockSpec((row_chunk, C_PAD), lambda j, i: (i, 0)),
+            pl.BlockSpec((row_chunk, 1), lambda j, i: (i, 0)),
+            pl.BlockSpec((1, 8, lanes), lambda j, i: (j, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((C_PAD, bb * f_pad_to), lambda j, i: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((C_PAD, b_pad * f_pad_to), jnp.float32),
+    )(leaf_arr, bins, ghc, row_leaf2d, lane_bin)
+
+    # undo lane layout: blocks of bb bins, each lane = local_bin * F + feat
+    hist = out[:c].reshape(c, n_bb * bb, f_pad_to)   # (C, bin, feat)
+    hist = hist.transpose(2, 1, 0)                   # (feat, bin, C)
+    return hist[:num_feat, :num_bins, :]
+
+
+def pallas_available(num_bins: int) -> bool:
+    if num_bins > MAX_PALLAS_BINS:
+        return False
+    try:
+        dev = jax.devices()[0]
+    except Exception:  # pragma: no cover
+        return False
+    return dev.platform in ("tpu", "axon") or "TPU" in str(dev)

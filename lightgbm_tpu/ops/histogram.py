@@ -32,23 +32,31 @@ import numpy as np
 DEFAULT_CHUNK = 4096
 
 
-def _hist_chunk(bins_c: jax.Array, ghc_c: jax.Array, num_bins: int) -> jax.Array:
+def _hist_chunk(bins_c: jax.Array, ghc_c: jax.Array, num_bins: int,
+                mxu_bf16: bool = False) -> jax.Array:
     """(chunk, F) int bins + (chunk, C) channels -> (F*B, C) partial histogram.
 
-    The one-hot matrix is exact in bfloat16 (0/1); the float32 channels are
-    split into hi+lo bfloat16 halves so two bf16 MXU passes reproduce f32
-    accuracy (f32 accumulate via preferred_element_type) at ~3x the speed of
-    XLA's 6-pass f32 matmul emulation.
+    Contraction order is (C, chunk) @ (chunk, F*B): the wide F*B axis sits on
+    the MXU's 128-lane output dimension; the tiny channel axis pads only the
+    sublane side. On TPU (``mxu_bf16``) the one-hot materializes in bfloat16
+    (exact 0/1, half the HBM traffic — this pass is bandwidth-bound) and the
+    f32 channels split hi+lo so two bf16 MXU passes keep f32 accuracy; on CPU
+    everything stays exact f32 for the test reference.
     """
     chunk, num_feat = bins_c.shape
     iota = jnp.arange(num_bins, dtype=bins_c.dtype)
     onehot = (bins_c[:, :, None] == iota).reshape(chunk, num_feat * num_bins)
-    oh = onehot.astype(jnp.bfloat16).T
-    hi = ghc_c.astype(jnp.bfloat16)
-    lo = (ghc_c - hi.astype(jnp.float32)).astype(jnp.bfloat16)
-    out = jax.lax.dot(oh, hi, preferred_element_type=jnp.float32)
-    out = out + jax.lax.dot(oh, lo, preferred_element_type=jnp.float32)
-    return out
+    if mxu_bf16:
+        oh = onehot.astype(jnp.bfloat16)
+        hi = ghc_c.astype(jnp.bfloat16)
+        lo = (ghc_c - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+        out = jax.lax.dot(hi.T, oh, preferred_element_type=jnp.float32)
+        out = out + jax.lax.dot(lo.T, oh, preferred_element_type=jnp.float32)
+        return out.T
+    out = jax.lax.dot(ghc_c.astype(jnp.float32).T, onehot.astype(jnp.float32),
+                      precision=jax.lax.Precision.HIGHEST,
+                      preferred_element_type=jnp.float32)
+    return out.T
 
 
 def build_histogram(
@@ -56,6 +64,7 @@ def build_histogram(
     ghc: jax.Array,
     num_bins: int,
     chunk: int = DEFAULT_CHUNK,
+    mxu_bf16: bool = False,
 ) -> jax.Array:
     """Accumulate ``(F, num_bins, C)`` histogram of channel sums per bin.
 
@@ -71,7 +80,7 @@ def build_histogram(
         ghc = jnp.pad(ghc, ((0, pad), (0, 0)))
     nchunks = (n + pad) // chunk
     if nchunks == 1:
-        flat = _hist_chunk(bins, ghc, num_bins)
+        flat = _hist_chunk(bins, ghc, num_bins, mxu_bf16)
         return flat.reshape(num_feat, num_bins, c)
 
     bins_r = bins.reshape(nchunks, chunk, num_feat)
@@ -79,7 +88,7 @@ def build_histogram(
 
     def body(acc, xs):
         b, g = xs
-        return acc + _hist_chunk(b, g, num_bins), None
+        return acc + _hist_chunk(b, g, num_bins, mxu_bf16), None
 
     acc0 = jnp.zeros((num_feat * num_bins, c), dtype=jnp.float32)
     acc, _ = jax.lax.scan(body, acc0, (bins_r, ghc_r))
@@ -97,6 +106,7 @@ def build_histogram_np(bins: np.ndarray, ghc: np.ndarray, num_bins: int) -> np.n
     return out.astype(np.float32)
 
 
-@partial(jax.jit, static_argnames=("num_bins", "chunk"))
-def build_histogram_jit(bins, ghc, num_bins: int, chunk: int = DEFAULT_CHUNK):
-    return build_histogram(bins, ghc, num_bins, chunk)
+@partial(jax.jit, static_argnames=("num_bins", "chunk", "mxu_bf16"))
+def build_histogram_jit(bins, ghc, num_bins: int, chunk: int = DEFAULT_CHUNK,
+                        mxu_bf16: bool = False):
+    return build_histogram(bins, ghc, num_bins, chunk, mxu_bf16)

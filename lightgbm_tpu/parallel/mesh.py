@@ -63,15 +63,9 @@ class DataParallelTreeLearner(SerialTreeLearner):
         self.rep_sharding = NamedSharding(mesh, P())
         self.bins = jax.device_put(jnp.asarray(bins_np), self.row_sharding)
 
-        inner = partial(
-            build_tree,
-            hp=self.hp, num_leaves=self.num_leaves, num_bin=self.num_bin,
-            max_depth=int(config.max_depth),
-            feature_fraction_bynode=float(config.feature_fraction_bynode),
-            extra_trees=bool(config.extra_trees),
-            comm=Comm(DATA_AXIS),
-            hist_chunk=2048,
-        )
+        kw = self.build_kwargs()
+        kw["comm"] = Comm(DATA_AXIS)
+        inner = partial(build_tree, **kw)
         sharded = jax.shard_map(
             inner, mesh=mesh,
             in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(), P(), P()),

@@ -128,6 +128,10 @@ class Tree:
             t.leaf_weight[new_leaf], t.leaf_count[new_leaf] = hr, int(round(cr))
             leaf_slot[l] = (r, 0)
             leaf_slot[new_leaf] = (r, 1)
+        if num_splits == 0:
+            # no usable split: the tree contributes nothing (reference:
+            # gbdt.cpp keeps the stump but never applies its output)
+            return t
         t.leaf_value[:num_leaves] = np.asarray(leaf_value[:num_leaves], dtype=np.float64)
         return t
 

@@ -1,0 +1,84 @@
+"""CLI train/predict on LightGBM-style config files (model: reference
+tests/python_package_test/test_consistency.py which drives examples/*
+configs)."""
+import os
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.cli import Application, parse_args
+from lightgbm_tpu.io import detect_format, load_text_file
+from lightgbm_tpu.config import Config
+
+
+@pytest.fixture
+def tiny_csv(tmp_path, rng):
+    n = 400
+    X = rng.randn(n, 5)
+    y = (X[:, 0] - X[:, 1] > 0).astype(float)
+    data = np.column_stack([y, X])
+    path = tmp_path / "train.csv"
+    np.savetxt(path, data, delimiter=",", fmt="%.6f")
+    return str(path)
+
+
+def test_detect_format():
+    assert detect_format(["1,2,3"]) == "csv"
+    assert detect_format(["1\t2\t3"]) == "tsv"
+    assert detect_format(["1 2:0.5 7:1.2"]) == "libsvm"
+
+
+def test_load_tsv_with_query(tmp_path, rng):
+    n = 60
+    X = rng.randn(n, 3)
+    y = rng.randint(0, 3, n)
+    np.savetxt(tmp_path / "rank.tsv", np.column_stack([y, X]), delimiter="\t",
+               fmt="%.5f")
+    np.savetxt(tmp_path / "rank.tsv.query", np.asarray([20, 20, 20]), fmt="%d")
+    Xl, yl, w, group, _ = load_text_file(str(tmp_path / "rank.tsv"), Config())
+    assert Xl.shape == (n, 3)
+    assert group.tolist() == [20, 20, 20]
+
+
+def test_load_libsvm(tmp_path):
+    p = tmp_path / "data.svm"
+    p.write_text("1 0:1.5 3:2.0\n0 1:0.5\n1 2:1.0 3:-1\n")
+    X, y, _, _, _ = load_text_file(str(p), Config())
+    assert X.shape == (3, 4)
+    assert y.tolist() == [1, 0, 1]
+    assert X[0, 0] == 1.5 and X[1, 1] == 0.5 and X[2, 3] == -1
+
+
+def test_cli_train_predict(tmp_path, tiny_csv):
+    conf = tmp_path / "train.conf"
+    model = tmp_path / "model.txt"
+    conf.write_text(
+        "task = train\n"
+        "objective = binary\n"
+        "data = %s\n"
+        "num_iterations = 10\n"
+        "num_leaves = 7\n"
+        "min_data_in_leaf = 5\n"
+        "output_model = %s\n"
+        "verbosity = -1\n" % (tiny_csv, model))
+    Application(parse_args(["config=%s" % conf])).run()
+    assert model.exists()
+
+    out = tmp_path / "pred.txt"
+    Application(parse_args([
+        "task=predict", "data=%s" % tiny_csv, "input_model=%s" % model,
+        "output_result=%s" % out, "verbosity=-1"])).run()
+    preds = np.loadtxt(out)
+    assert preds.shape == (400,)
+    assert (preds >= 0).all() and (preds <= 1).all()
+
+
+def test_cli_key_value_overrides(tmp_path, tiny_csv):
+    model = tmp_path / "m.txt"
+    Application(parse_args([
+        "task=train", "objective=binary", "data=%s" % tiny_csv,
+        "num_trees=5", "num_leaves=4", "min_data_in_leaf=5",
+        "output_model=%s" % model, "verbosity=-1"])).run()
+    from lightgbm_tpu.basic import Booster
+    bst = Booster(model_file=str(model))
+    assert bst.num_trees() == 5
