@@ -182,7 +182,9 @@ class Booster:
             self.config = self.inner.config
         else:
             raise LightGBMError("Need train_set, model_file or model_str")
-        self.best_iteration = -1
+        # loaded models keep their stored best_iteration so predict()
+        # defaults to the early-stopped tree count like the reference
+        self.best_iteration = self.inner.best_iteration if train_set is None else -1
         self.best_score: Dict[str, Dict[str, float]] = {}
 
     def add_valid(self, data: Dataset, name: str) -> "Booster":

@@ -18,7 +18,8 @@ import numpy as np
 
 from .config import Config
 from .dataset import BinnedDataset
-from .learner import SerialTreeLearner, TreeLog, assign_leaves
+from .learner import (SerialTreeLearner, TreeLog, assign_leaves,
+                      leaf_values_by_row)
 from .metric import Metric, create_metrics
 from .objective import ObjectiveFunction, create_objective
 from .tree import Tree
@@ -36,7 +37,8 @@ class ScoreTracker:
 
     def add(self, leaf_values: np.ndarray, leaf_assign: jax.Array, class_id: int,
             num_class: int) -> None:
-        vals = jnp.asarray(leaf_values, jnp.float32)[leaf_assign]
+        lv = jnp.asarray(leaf_values, jnp.float32)
+        vals = leaf_values_by_row(lv, leaf_assign, lv.shape[0])
         if num_class > 1:
             self.score = self.score.at[:, class_id].add(vals)
         else:
@@ -114,7 +116,9 @@ class GBDT:
         obj = self.objective
 
         @jax.jit
-        def grads(score):
+        def grads(score, it):
+            if obj.needs_iter:
+                return obj.get_gradients(score, it)
             return obj.get_gradients(score)
 
         self._grad_fn = grads
@@ -171,7 +175,7 @@ class GBDT:
                                                   side="left"))
                     thr_bin = min(thr_bin, mapper.num_bins - 1)
                     go_left = bvals <= thr_bin
-                    if mapper.missing_type == 2:
+                    if mapper.missing_type in (1, 2):  # Zero or NaN missing
                         dl = bool(tree.decision_type[nd] & 2)
                         go_left = np.where(bvals == mapper.missing_bin, dl, go_left)
                 node[sel] = np.where(go_left, tree.left_child[nd], tree.right_child[nd])
@@ -251,7 +255,7 @@ class GBDT:
         Returns True when no tree could be grown (all-stop signal)."""
         it = self.iter_
         if grad is None:
-            g, h = self._grad_fn(self.train_score.score)
+            g, h = self._grad_fn(self.train_score.score, jnp.int32(it))
         else:
             g = jnp.asarray(grad, jnp.float32)
             h = jnp.asarray(hess, jnp.float32)
@@ -487,7 +491,10 @@ class GBDT:
         booster_cls = {"gbdt": cls, "dart": DART, "rf": RF}.get(
             kv.get("boosting", "gbdt"), cls)
         model = booster_cls.__new__(booster_cls)
-        GBDT.__init__(model, config, None)
+        # run the full subclass constructor chain so DART/RF state
+        # (_tree_weights/_drop_rng/_init_score_dev) exists for continued
+        # training on a loaded model
+        booster_cls.__init__(model, config, None)
         model.num_tree_per_iteration = int(kv.get("num_tree_per_iteration", 1))
         model.num_class = int(kv.get("num_class", 1))
         init = kv.get("init_score", "0").split()
@@ -638,12 +645,13 @@ class RF(GBDT):
     def __init__(self, config: Config, train_set: Optional[BinnedDataset],
                  comm_axis: Optional[str] = None) -> None:
         super().__init__(config, train_set, comm_axis)
+        self._init_score_dev = None
         if train_set is not None:
             self._init_score_dev = self.train_score.score
 
     def train_one_iter(self, grad=None, hess=None) -> bool:
         if grad is None:
-            g, h = self._grad_fn(self._init_score_dev)
+            g, h = self._grad_fn(self._init_score_dev, jnp.int32(self.iter_))
         else:
             g, h = jnp.asarray(grad, jnp.float32), jnp.asarray(hess, jnp.float32)
         it = self.iter_
@@ -670,18 +678,21 @@ class RF(GBDT):
         if self.num_class > 1:
             init_col = self.init_scores[class_id]
             old = self.train_score.score[:, class_id] - init_col
-            new = (old * it + jnp.asarray(tree.leaf_value, jnp.float32)[log.row_leaf]) \
+            lv = jnp.asarray(tree.leaf_value, jnp.float32)
+            new = (old * it + leaf_values_by_row(lv, log.row_leaf, lv.shape[0])) \
                 / (it + 1)
             self.train_score.score = self.train_score.score.at[:, class_id].set(
                 new + init_col)
         else:
             old = self.train_score.score - self.init_scores[0]
-            new = (old * it + jnp.asarray(tree.leaf_value, jnp.float32)[log.row_leaf]) \
+            lv = jnp.asarray(tree.leaf_value, jnp.float32)
+            new = (old * it + leaf_values_by_row(lv, log.row_leaf, lv.shape[0])) \
                 / (it + 1)
             self.train_score.score = new + self.init_scores[0]
         for _, vset, vscore in self.valid_sets:
             vleaf = assign_leaves(self._valid_bins(vset), log)
-            vals = jnp.asarray(tree.leaf_value, jnp.float32)[vleaf]
+            lv = jnp.asarray(tree.leaf_value, jnp.float32)
+            vals = leaf_values_by_row(lv, vleaf, lv.shape[0])
             if self.num_class > 1:
                 init_col = self.init_scores[class_id]
                 old = vscore.score[:, class_id] - init_col

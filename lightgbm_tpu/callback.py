@@ -87,61 +87,97 @@ def reset_parameter(**kwargs: Union[list, Callable]) -> Callable:
     return _callback
 
 
+class _MetricState:
+    """Best-so-far tracker for one (dataset, metric) evaluation stream."""
+
+    __slots__ = ("best_value", "best_round", "best_results", "sign", "tol")
+
+    def __init__(self, greater_is_better: bool, min_delta: float) -> None:
+        # store scores as "higher is better" internally so one comparison
+        # serves both orientations
+        self.sign = 1.0 if greater_is_better else -1.0
+        self.tol = abs(min_delta)
+        self.best_value = float("-inf")
+        self.best_round = 0
+        self.best_results = None
+
+    def update(self, value: float, round_idx: int, results) -> None:
+        oriented = self.sign * value
+        if oriented > self.best_value + self.tol or self.best_results is None:
+            self.best_value = oriented
+            self.best_round = round_idx
+            self.best_results = results
+
+    def rounds_since_best(self, round_idx: int) -> int:
+        return round_idx - self.best_round
+
+
+class _EarlyStopper:
+    """Stop when no tracked validation metric improved for
+    ``stopping_rounds`` consecutive rounds (reference behavior:
+    python-package/lightgbm/callback.py early_stopping; implementation is
+    original)."""
+
+    order = 30
+    before_iteration = False
+
+    def __init__(self, stopping_rounds: int, first_metric_only: bool,
+                 verbose: bool, min_delta: float) -> None:
+        if stopping_rounds <= 0:
+            raise ValueError("stopping_rounds must be positive")
+        self.patience = int(stopping_rounds)
+        self.first_metric_only = first_metric_only
+        self.verbose = verbose
+        self.min_delta = min_delta
+        self.states: Dict[tuple, _MetricState] = {}
+        self.active = None  # None = not yet initialized
+
+    def _report(self, prefix: str, state: _MetricState) -> None:
+        if self.verbose:
+            detail = "\t".join(_fmt_eval(x) for x in state.best_results)
+            Log.info("%s best iteration is: [%d]\t%s",
+                     prefix, state.best_round + 1, detail)
+
+    def __call__(self, env: CallbackEnv) -> None:
+        results = env.evaluation_result_list
+        if self.active is None:
+            self.active = bool(results)
+            if not self.active:
+                Log.warning("Early stopping requires at least one validation set")
+            elif self.verbose:
+                Log.info("Training until validation scores don't improve "
+                         "for %d rounds", self.patience)
+        if not self.active:
+            return
+        tracked_metric = results[0][1]
+        stop_with = None
+        for name, metric, value, greater_is_better in results:
+            key = (name, metric)
+            state = self.states.get(key)
+            if state is None:
+                state = self.states[key] = _MetricState(greater_is_better,
+                                                        self.min_delta)
+            state.update(value, env.iteration, results)
+            if name == "training":
+                continue  # never stop on the training metric
+            if self.first_metric_only and metric != tracked_metric:
+                continue
+            if stop_with is None and \
+                    state.rounds_since_best(env.iteration) >= self.patience:
+                stop_with = state
+        last_round = env.iteration == env.end_iteration - 1
+        if stop_with is None and last_round:
+            for (name, _), state in self.states.items():
+                if name != "training":
+                    self._report("Did not meet early stopping.", state)
+                    raise EarlyStopException(state.best_round, state.best_results)
+        if stop_with is not None:
+            self._report("Early stopping,", stop_with)
+            raise EarlyStopException(stop_with.best_round, stop_with.best_results)
+
+
 def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
                    verbose: bool = True, min_delta: float = 0.0) -> Callable:
-    """(reference: callback.py:146)"""
-    best_score: List[float] = []
-    best_iter: List[int] = []
-    best_score_list: List[Any] = []
-    cmp_op: List[Callable] = []
-    enabled = [True]
-    first_metric = [""]
-
-    def _init(env: CallbackEnv) -> None:
-        enabled[0] = bool(env.evaluation_result_list)
-        if not enabled[0]:
-            Log.warning("Early stopping requires at least one validation set")
-            return
-        if verbose:
-            Log.info("Training until validation scores don't improve for %d rounds",
-                     stopping_rounds)
-        first_metric[0] = env.evaluation_result_list[0][1]
-        for _, _, _, greater_is_better in env.evaluation_result_list:
-            best_iter.append(0)
-            best_score_list.append(None)
-            if greater_is_better:
-                best_score.append(float("-inf"))
-                cmp_op.append(lambda x, y: x > y + min_delta)
-            else:
-                best_score.append(float("inf"))
-                cmp_op.append(lambda x, y: x < y - min_delta)
-
-    def _callback(env: CallbackEnv) -> None:
-        if not best_score:
-            _init(env)
-        if not enabled[0]:
-            return
-        for i, (name, metric, value, _) in enumerate(env.evaluation_result_list):
-            if best_score_list[i] is None or cmp_op[i](value, best_score[i]):
-                best_score[i] = value
-                best_iter[i] = env.iteration
-                best_score_list[i] = env.evaluation_result_list
-            if first_metric_only and first_metric[0] != metric:
-                continue
-            if name == "training":
-                continue
-            if env.iteration - best_iter[i] >= stopping_rounds:
-                if verbose:
-                    Log.info("Early stopping, best iteration is: [%d]\t%s",
-                             best_iter[i] + 1,
-                             "\t".join(_fmt_eval(x) for x in best_score_list[i]))
-                raise EarlyStopException(best_iter[i], best_score_list[i])
-            if env.iteration == env.end_iteration - 1:
-                if verbose:
-                    Log.info("Did not meet early stopping. Best iteration is: [%d]\t%s",
-                             best_iter[i] + 1,
-                             "\t".join(_fmt_eval(x) for x in best_score_list[i]))
-                raise EarlyStopException(best_iter[i], best_score_list[i])
-
-    _callback.order = 30
-    return _callback
+    """Early-stopping callback factory (same surface as the reference
+    python package's ``early_stopping``)."""
+    return _EarlyStopper(stopping_rounds, first_metric_only, verbose, min_delta)

@@ -208,6 +208,12 @@ class Config:
     tpu_rows_per_chunk: int = 65536  # rows per device histogram chunk
     tpu_donate_buffers: bool = True
     tpu_iter_block: int = 10         # boosting iterations fused per device launch
+    tree_builder: str = "auto"       # auto|partition|dense: partitioned
+    #   leaf-contiguous builder (O(child) histograms) vs round-1 dense
+    #   (O(N) masked histograms; required when max_bin > 256)
+    tpu_part_chunk: int = 2048       # rows per partition compaction chunk
+    tpu_hist_chunk: int = 2048       # rows per segment-histogram chunk
+    tpu_hist_precision: str = "hilo"  # hilo (~2^-17 rel, bf16 pair) | bf16
 
     # resolved, not user-set
     num_original_features: int = 0

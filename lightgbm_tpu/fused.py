@@ -24,7 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .config import Config
-from .learner import SerialTreeLearner, TreeLog, build_tree
+from .learner import SerialTreeLearner, TreeLog, leaf_values_by_row
 
 
 class BlockLogs(NamedTuple):
@@ -138,10 +138,13 @@ class FusedTrainer:
         ffrac = float(cfg.feature_fraction)
         bins = learner.bins
         meta = learner.meta
-        build = partial(build_tree, **learner.build_kwargs())
+        build = learner.make_build_fn()
 
         def one_iter(score, key, it):
-            g, h = obj.get_gradients(score)
+            if obj.needs_iter:
+                g, h = obj.get_gradients(score, it)
+            else:
+                g, h = obj.get_gradients(score)
             if sampler is not None:
                 inbag, amp = sampler(key, it, g, h)
             else:
@@ -165,7 +168,8 @@ class FusedTrainer:
                 ghc = jnp.stack([gc, hc, cnt], axis=1)
                 log = build(bins, ghc, meta, fmask, jax.random.fold_in(key, it * 131 + c))
                 vals = log.leaf_value * jnp.float32(lr)
-                upd = vals[log.row_leaf] * (log.num_splits > 0)
+                upd = leaf_values_by_row(vals, log.row_leaf, vals.shape[0]) \
+                    * (log.num_splits > 0)
                 if K > 1:
                     score = score.at[:, c].add(upd)
                 else:

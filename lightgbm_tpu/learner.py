@@ -70,6 +70,8 @@ class TreeLog(NamedTuple):
     left_sum: jax.Array       # (L-1, 3) f32
     right_sum: jax.Array      # (L-1, 3) f32
     go_left: jax.Array        # (L-1, B) bool
+    miss_bin: jax.Array       # (L-1,) i32 movable-missing bin of the feature
+    movable: jax.Array        # (L-1,) bool feature has missing-directed bin
     leaf_value: jax.Array     # (L,) f32 raw outputs (pre-shrinkage)
     leaf_sum: jax.Array       # (L, 3) f32
     row_leaf: jax.Array       # (N,) i32 final leaf of every training row
@@ -95,31 +97,13 @@ def _set_best(best: SplitInfo, idx, info: SplitInfo) -> SplitInfo:
     return jax.tree.map(lambda b, v: b.at[idx].set(v), best, info)
 
 
-def build_tree(
-    bins: jax.Array,          # (N, F) uint8/16 — row shard on this device
-    ghc: jax.Array,           # (N, 3) f32 (grad, hess, inbag) — masked already
-    meta: FeatureMeta,
-    feature_mask: jax.Array,  # (F,) bool, per-tree column sample
-    key: jax.Array,           # PRNG for by-node sampling / extra-trees
-    hp: SplitHyper,
-    *,
-    num_leaves: int,
-    num_bin: int,
-    max_depth: int = -1,
-    feature_fraction_bynode: float = 1.0,
-    extra_trees: bool = False,
-    comm: Comm = Comm(),
-    hist_chunk: int = 2048,
-    constraint_sets: Optional[jax.Array] = None,   # (S, F) bool, static presence
-    forced: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None,
-    # forced = (leaf (R,), feature (R,), bin (R,)) BFS-ordered forced splits
-    use_pallas: bool = False,
-    mxu_bf16: bool = False,
-) -> TreeLog:
-    """Grow one leaf-wise tree entirely on device. jit/shard_map once."""
-    n, num_feat = bins.shape
-    max_splits = num_leaves - 1
-    n_forced = 0 if forced is None else int(forced[0].shape[0])
+
+def _make_best_for(meta: FeatureMeta, hp: SplitHyper, key, feature_mask,
+                   num_feat: int, feature_fraction_bynode: float,
+                   extra_trees: bool, constraint_sets):
+    """Shared per-node split evaluation: by-node column sampling,
+    extra-trees random thresholds, interaction constraints, then the
+    vectorized (F, B) best-split scan."""
 
     def allowed_mask(used_row):
         """Interaction constraints (reference: col_sampler.hpp:94 GetByNode):
@@ -129,19 +113,6 @@ def build_tree(
             return jnp.ones((num_feat,), bool)
         compat = jnp.all(~used_row[None, :] | constraint_sets, axis=1)  # (S,)
         return jnp.any(constraint_sets & compat[:, None], axis=0)
-
-    def hist_of_leaf(row_leaf, leaf_id):
-        """Histogram of the rows currently on ``leaf_id`` (all rows when
-        leaf_id < 0). TPU: Pallas kernel with the leaf mask computed
-        in-kernel; elsewhere: masked one-hot matmul."""
-        if use_pallas:
-            from .ops.hist_pallas import hist_pallas
-            h = hist_pallas(bins, ghc, row_leaf, leaf_id, num_bin)
-        else:
-            mask = (jnp.asarray(leaf_id) < 0) | (row_leaf == leaf_id)
-            h = build_histogram(bins, ghc * mask[:, None].astype(jnp.float32),
-                                num_bin, hist_chunk, mxu_bf16=mxu_bf16)
-        return comm.psum(h)
 
     def node_inputs(r, leaf):
         """Per-node RNG-driven feature mask and extra-trees thresholds."""
@@ -167,6 +138,46 @@ def build_tree(
             hist, parent_sum, meta, fmask, hp,
             parent_output=parent_out, leaf_lower=lower, leaf_upper=upper,
             rand_threshold=rand_thr)
+
+    return best_for
+
+
+def build_tree(
+    bins: jax.Array,          # (N, F) uint8/16 — row shard on this device
+    ghc: jax.Array,           # (N, 3) f32 (grad, hess, inbag) — masked already
+    meta: FeatureMeta,
+    feature_mask: jax.Array,  # (F,) bool, per-tree column sample
+    key: jax.Array,           # PRNG for by-node sampling / extra-trees
+    hp: SplitHyper,
+    *,
+    num_leaves: int,
+    num_bin: int,
+    max_depth: int = -1,
+    feature_fraction_bynode: float = 1.0,
+    extra_trees: bool = False,
+    comm: Comm = Comm(),
+    hist_chunk: int = 2048,
+    constraint_sets: Optional[jax.Array] = None,   # (S, F) bool, static presence
+    forced: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None,
+    # forced = (leaf (R,), feature (R,), bin (R,)) BFS-ordered forced splits
+    mxu_bf16: bool = False,
+) -> TreeLog:
+    """Grow one leaf-wise tree entirely on device. jit/shard_map once."""
+    n, num_feat = bins.shape
+    max_splits = num_leaves - 1
+    n_forced = 0 if forced is None else int(forced[0].shape[0])
+
+    def hist_of_leaf(row_leaf, leaf_id):
+        """Histogram of the rows currently on ``leaf_id`` (all rows when
+        leaf_id < 0): masked one-hot matmul over the full row set."""
+        mask = (jnp.asarray(leaf_id) < 0) | (row_leaf == leaf_id)
+        h = build_histogram(bins, ghc * mask[:, None].astype(jnp.float32),
+                            num_bin, hist_chunk, mxu_bf16=mxu_bf16)
+        return comm.psum(h)
+
+    best_for = _make_best_for(meta, hp, key, feature_mask, num_feat,
+                              feature_fraction_bynode, extra_trees,
+                              constraint_sets)
 
     # ---- init: root ----
     root_sum = comm.psum(jnp.sum(ghc, axis=0))
@@ -196,6 +207,8 @@ def build_tree(
         left_sum=jnp.zeros((max_splits, 3), jnp.float32),
         right_sum=jnp.zeros((max_splits, 3), jnp.float32),
         go_left=jnp.zeros((max_splits, num_bin), bool),
+        miss_bin=jnp.zeros((max_splits,), jnp.int32),
+        movable=jnp.zeros((max_splits,), bool),
         leaf_value=leaf_out,
         leaf_sum=leaf_sum,
         row_leaf=row_leaf,
@@ -274,6 +287,8 @@ def build_tree(
             left_sum=log.left_sum.at[s].set(info.left_sum),
             right_sum=log.right_sum.at[s].set(info.right_sum),
             go_left=log.go_left.at[s].set(info.go_left),
+            miss_bin=log.miss_bin.at[s].set(meta.missing_bin[info.feature]),
+            movable=log.movable.at[s].set(meta.movable_missing[info.feature]),
         )
 
         # ---- stats bookkeeping ----
@@ -334,11 +349,275 @@ def build_tree(
     return log._replace(leaf_value=leaf_out, leaf_sum=leaf_sum, row_leaf=row_leaf)
 
 
-def assign_leaves(bins: jax.Array, log: TreeLog) -> jax.Array:
+
+
+def build_tree_partitioned(
+    bins: jax.Array,          # (N, F) uint8 — row shard on this device
+    ghc: jax.Array,           # (N, 3) f32 (grad, hess, inbag) — masked already
+    meta: FeatureMeta,
+    feature_mask: jax.Array,  # (F,) bool, per-tree column sample
+    key: jax.Array,           # PRNG for by-node sampling / extra-trees
+    hp: SplitHyper,
+    *,
+    num_leaves: int,
+    num_bin: int,
+    max_depth: int = -1,
+    feature_fraction_bynode: float = 1.0,
+    extra_trees: bool = False,
+    comm: Comm = Comm(),
+    hist_chunk: int = 2048,
+    part_chunk: int = 2048,
+    hist_exact: bool = True,
+    constraint_sets: Optional[jax.Array] = None,   # (S, F) bool
+    forced: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None,
+) -> TreeLog:
+    """Grow one leaf-wise tree with a physical row partition.
+
+    The scaling-correct builder (reference contract:
+    src/treelearner/serial_tree_learner.cpp:324 FindBestSplits over the
+    smaller leaf + histogram subtraction, src/treelearner/data_partition.hpp
+    :101 Split): per split, the parent's rows are stably partitioned into
+    leaf-contiguous segments (ops/partition.py) and only the SMALLER child's
+    segment is histogrammed (ops/histogram.py hist16_segment); the larger
+    child's histogram is parent - smaller. Per-split cost is O(parent rows),
+    per-histogram cost O(child rows) — round 1 paid O(N) for both, ~100x
+    more arithmetic at 255 leaves.
+
+    Same in/out contract as ``build_tree``; runs identically single-device
+    or under shard_map (all collectives go through ``comm``).
+    """
+    from .ops.histogram import hist16_segment
+    from .ops.partition import pack_rows, partition_segment
+
+    n, num_feat = bins.shape
+    max_splits = num_leaves - 1
+    n_forced = 0 if forced is None else int(forced[0].shape[0])
+    guard = max(part_chunk, hist_chunk)
+
+    # ---- packed ping-pong working buffers with guard rows ----
+    pad = ((guard, guard), (0, 0))
+    work0 = pack_rows(jnp.pad(bins, pad), jnp.pad(ghc, pad))
+    work = jnp.stack([work0, jnp.zeros_like(work0)])     # (2, Npad, F+12)
+
+    def hist_of(work, plane, start, cnt):
+        h = hist16_segment(work, plane, start, cnt, num_bins=num_bin,
+                           num_feat=num_feat, exact=hist_exact,
+                           chunk=hist_chunk)
+        return comm.psum(h)
+
+    best_for = _make_best_for(meta, hp, key, feature_mask, num_feat,
+                              feature_fraction_bynode, extra_trees,
+                              constraint_sets)
+
+    # ---- init: root ----
+    root_sum = comm.psum(jnp.sum(ghc, axis=0))
+    root_hist = hist_of(work, jnp.int32(0), jnp.int32(guard), jnp.int32(n))
+    hist_pool = jnp.zeros((num_leaves, num_feat, num_bin, 3), jnp.float32)
+    hist_pool = hist_pool.at[0].set(root_hist)
+    leaf_sum = jnp.zeros((num_leaves, 3), jnp.float32).at[0].set(root_sum)
+    leaf_out = jnp.zeros((num_leaves,), jnp.float32).at[0].set(
+        calc_leaf_output(root_sum[0], root_sum[1], hp))
+    leaf_depth = jnp.zeros((num_leaves,), jnp.int32)
+    leaf_lower = jnp.full((num_leaves,), -jnp.inf, jnp.float32)
+    leaf_upper = jnp.full((num_leaves,), jnp.inf, jnp.float32)
+    leaf_used = jnp.zeros((num_leaves, num_feat), bool)
+    leaf_start = jnp.zeros((num_leaves,), jnp.int32).at[0].set(guard)
+    leaf_cnt = jnp.zeros((num_leaves,), jnp.int32).at[0].set(n)
+    leaf_parity = jnp.zeros((num_leaves,), jnp.int32)
+    best = _empty_best(num_leaves, num_bin)
+    best = _set_best(best, 0, best_for(0, jnp.int32(0), root_hist, root_sum,
+                                       leaf_out[0], leaf_lower[0],
+                                       leaf_upper[0], leaf_used[0]))
+    log = TreeLog(
+        num_splits=jnp.int32(0),
+        split_leaf=jnp.zeros((max_splits,), jnp.int32),
+        feature=jnp.zeros((max_splits,), jnp.int32),
+        bin=jnp.zeros((max_splits,), jnp.int32),
+        kind=jnp.zeros((max_splits,), jnp.int32),
+        default_left=jnp.zeros((max_splits,), bool),
+        gain=jnp.zeros((max_splits,), jnp.float32),
+        left_sum=jnp.zeros((max_splits, 3), jnp.float32),
+        right_sum=jnp.zeros((max_splits, 3), jnp.float32),
+        go_left=jnp.zeros((max_splits, num_bin), bool),
+        miss_bin=jnp.zeros((max_splits,), jnp.int32),
+        movable=jnp.zeros((max_splits,), bool),
+        leaf_value=leaf_out,
+        leaf_sum=leaf_sum,
+        row_leaf=jnp.zeros((n,), jnp.int32),
+    )
+
+    def depth_ok(depth):
+        if max_depth <= 0:
+            return jnp.bool_(True)
+        return depth < max_depth
+
+    force_live = jnp.bool_(n_forced > 0)
+    carry0 = (jnp.int32(0), work, leaf_start, leaf_cnt, leaf_parity,
+              hist_pool, leaf_sum, leaf_out, leaf_depth, leaf_lower,
+              leaf_upper, best, log, leaf_used, force_live)
+
+    def cond(carry):
+        r, best, log, force_live = carry[0], carry[11], carry[12], carry[14]
+        forcing = force_live & (r < n_forced) if n_forced else False
+        return (log.num_splits < max_splits) & (r < max_splits + n_forced) \
+            & ((jnp.max(best.gain) > 0.0) | forcing)
+
+    def body(carry):
+        (r, work, leaf_start, leaf_cnt, leaf_parity, hist_pool, leaf_sum,
+         leaf_out, leaf_depth, leaf_lower, leaf_upper, best, log, leaf_used,
+         force_live) = carry
+        leaf = jnp.argmax(best.gain).astype(jnp.int32)
+        info: SplitInfo = jax.tree.map(lambda a: a[leaf], best)
+        if n_forced:
+            # forced splits (reference: serial_tree_learner.cpp:450
+            # ForceSplits) — same protocol as build_tree
+            f_leaf, f_feat, f_bin = forced
+
+            def pick_forced(_):
+                ri = jnp.minimum(r, n_forced - 1)
+                fl = f_leaf[ri]
+                fi = find_best_split(
+                    hist_pool[fl], leaf_sum[fl], meta,
+                    jnp.arange(num_feat) == f_feat[ri], hp,
+                    parent_output=leaf_out[fl], leaf_lower=leaf_lower[fl],
+                    leaf_upper=leaf_upper[fl],
+                    rand_threshold=jnp.full((num_feat,), f_bin[ri], jnp.int32))
+                ok = fi.gain > -jnp.inf
+                return (jnp.where(ok, fl, leaf),
+                        jax.tree.map(lambda a, b: jnp.where(ok, a, b), fi, info),
+                        ok)
+
+            use_forced = force_live & (r < n_forced)
+            leaf, info, force_live = jax.lax.cond(
+                use_forced, pick_forced,
+                lambda _: (leaf, info, jnp.bool_(False)), operand=None)
+        valid = info.gain > -jnp.inf
+        s = log.num_splits
+        new_leaf = s + 1
+
+        def sel(a, b):
+            """Commit only when the round produced a valid split."""
+            return jnp.where(valid, a, b)
+
+        # ---- physical partition of the parent's segment ----
+        # (invalid rounds write garbage into dead regions of the other
+        # plane — harmless, since parity/segments only commit when valid)
+        start = leaf_start[leaf]
+        cnt = leaf_cnt[leaf]
+        parity = leaf_parity[leaf]
+        work, lt = partition_segment(work, parity, start, cnt, info.feature,
+                                     info.go_left, ch=part_chunk)
+        new_parity = 1 - parity
+
+        # ---- record ----
+        log = log._replace(
+            num_splits=sel(new_leaf, log.num_splits),
+            split_leaf=log.split_leaf.at[s].set(sel(leaf, log.split_leaf[s])),
+            feature=log.feature.at[s].set(sel(info.feature, log.feature[s])),
+            bin=log.bin.at[s].set(sel(info.bin, log.bin[s])),
+            kind=log.kind.at[s].set(sel(info.kind, log.kind[s])),
+            default_left=log.default_left.at[s].set(
+                sel(info.default_left, log.default_left[s])),
+            gain=log.gain.at[s].set(sel(info.gain, log.gain[s])),
+            left_sum=log.left_sum.at[s].set(sel(info.left_sum, log.left_sum[s])),
+            right_sum=log.right_sum.at[s].set(
+                sel(info.right_sum, log.right_sum[s])),
+            go_left=log.go_left.at[s].set(sel(info.go_left, log.go_left[s])),
+            miss_bin=log.miss_bin.at[s].set(
+                sel(meta.missing_bin[info.feature], log.miss_bin[s])),
+            movable=log.movable.at[s].set(
+                sel(meta.movable_missing[info.feature], log.movable[s])),
+        )
+
+        # ---- segment bookkeeping ----
+        leaf_start = leaf_start.at[new_leaf].set(
+            sel(start + lt, leaf_start[new_leaf]))
+        leaf_cnt = leaf_cnt.at[leaf].set(sel(lt, cnt)) \
+                           .at[new_leaf].set(sel(cnt - lt, leaf_cnt[new_leaf]))
+        leaf_parity = leaf_parity.at[leaf].set(sel(new_parity, parity)) \
+            .at[new_leaf].set(sel(new_parity, leaf_parity[new_leaf]))
+
+        # ---- stats bookkeeping ----
+        leaf_sum = leaf_sum.at[leaf].set(sel(info.left_sum, leaf_sum[leaf])) \
+            .at[new_leaf].set(sel(info.right_sum, leaf_sum[new_leaf]))
+        leaf_out = leaf_out.at[leaf].set(sel(info.left_output, leaf_out[leaf])) \
+            .at[new_leaf].set(sel(info.right_output, leaf_out[new_leaf]))
+        d = leaf_depth[leaf] + 1
+        leaf_depth = leaf_depth.at[leaf].set(sel(d, leaf_depth[leaf])) \
+            .at[new_leaf].set(sel(d, leaf_depth[new_leaf]))
+        if hp.has_monotone:
+            mono = meta.monotone[info.feature]
+            mid = (info.left_output + info.right_output) * 0.5
+            lo_l, up_l = leaf_lower[leaf], leaf_upper[leaf]
+            new_up_l = jnp.where(mono > 0, jnp.minimum(up_l, mid), up_l)
+            new_lo_r = jnp.where(mono > 0, jnp.maximum(lo_l, mid), lo_l)
+            new_lo_l = jnp.where(mono < 0, jnp.maximum(lo_l, mid), lo_l)
+            new_up_r = jnp.where(mono < 0, jnp.minimum(up_l, mid), up_l)
+            leaf_lower = leaf_lower.at[leaf].set(sel(new_lo_l, lo_l)) \
+                .at[new_leaf].set(sel(new_lo_r, leaf_lower[new_leaf]))
+            leaf_upper = leaf_upper.at[leaf].set(sel(new_up_l, up_l)) \
+                .at[new_leaf].set(sel(new_up_r, leaf_upper[new_leaf]))
+
+        # ---- histograms: the smaller child (by GLOBAL in-bag count, so all
+        # shards agree) gets a fresh pass over its contiguous segment; the
+        # larger child is parent - smaller (serial_tree_learner.cpp:418) ----
+        left_smaller = info.left_sum[2] <= info.right_sum[2]
+        small_start = jnp.where(left_smaller, start, start + lt)
+        small_cnt = jnp.where(left_smaller, lt, cnt - lt)
+        hist_small = hist_of(work, new_parity, small_start, small_cnt)
+        parent_hist = hist_pool[leaf]
+        hist_large = parent_hist - hist_small
+        hist_left = jnp.where(left_smaller, hist_small, hist_large)
+        hist_right = jnp.where(left_smaller, hist_large, hist_small)
+        hist_pool = hist_pool.at[leaf].set(sel(hist_left, parent_hist)) \
+            .at[new_leaf].set(sel(hist_right, hist_pool[new_leaf]))
+
+        # ---- refresh best splits for the two children ----
+        used_new = leaf_used[leaf].at[info.feature].set(True)
+        leaf_used = leaf_used.at[leaf].set(sel(used_new, leaf_used[leaf])) \
+            .at[new_leaf].set(sel(used_new, leaf_used[new_leaf]))
+
+        info_l = best_for(r, leaf, hist_left, info.left_sum,
+                          leaf_out[leaf], leaf_lower[leaf], leaf_upper[leaf],
+                          used_new)
+        info_r = best_for(r, new_leaf, hist_right, info.right_sum,
+                          leaf_out[new_leaf], leaf_lower[new_leaf],
+                          leaf_upper[new_leaf], used_new)
+        gate_l = depth_ok(leaf_depth[leaf]) & valid
+        gate_r = depth_ok(leaf_depth[new_leaf]) & valid
+        info_l = info_l._replace(gain=jnp.where(gate_l, info_l.gain, -jnp.inf))
+        info_r = info_r._replace(gain=jnp.where(gate_r, info_r.gain, -jnp.inf))
+        old_l = jax.tree.map(lambda a: a[leaf], best)
+        old_r = jax.tree.map(lambda a: a[new_leaf], best)
+        best = _set_best(best, leaf,
+                         jax.tree.map(sel, info_l, old_l))
+        best = _set_best(best, new_leaf,
+                         jax.tree.map(sel, info_r, old_r))
+
+        return (r + 1, work, leaf_start, leaf_cnt, leaf_parity, hist_pool,
+                leaf_sum, leaf_out, leaf_depth, leaf_lower, leaf_upper, best,
+                log, leaf_used, force_live)
+
+    carry = jax.lax.while_loop(cond, body, carry0)
+    (_, _, _, _, _, _, leaf_sum, leaf_out, _, _, _, _, log, _, _) = carry
+    row_leaf = assign_leaves(bins, log, has_categorical=hp.has_categorical)
+    return log._replace(leaf_value=leaf_out, leaf_sum=leaf_sum,
+                        row_leaf=row_leaf)
+
+
+def assign_leaves(bins: jax.Array, log: TreeLog,
+                  has_categorical: bool = True) -> jax.Array:
     """Route binned rows through a tree's split log (device analog of
     Tree::PredictLeafIndex over pre-binned data; used for valid-set score
     updates, mirroring ScoreUpdater's use of the data partition,
-    score_updater.hpp:88)."""
+    score_updater.hpp:88).
+
+    Numerical splits route arithmetically (bin <= threshold, with the
+    movable-missing bin overridden to the recorded default direction) —
+    no table gathers, which are slow on TPU. Categorical splits need the
+    full (B,) routing table; when the dataset has no categorical features
+    (static ``has_categorical=False``) that path is skipped entirely.
+    """
     n = bins.shape[0]
     max_splits = log.split_leaf.shape[0]
     row_leaf = jnp.zeros((n,), jnp.int32)
@@ -346,22 +625,58 @@ def assign_leaves(bins: jax.Array, log: TreeLog) -> jax.Array:
     def body(r, row_leaf):
         active = r < log.num_splits
         leaf = log.split_leaf[r]
-        bins_col = jnp.take(bins, log.feature[r], axis=1).astype(jnp.int32)
-        go_left_rows = log.go_left[r][bins_col]
-        upd = jnp.where((row_leaf == leaf) & ~go_left_rows, r + 1, row_leaf)
+        col = jnp.take(bins, log.feature[r], axis=1).astype(jnp.int32)
+
+        def go_numerical(col):
+            go = col <= log.bin[r]
+            return jnp.where(log.movable[r] & (col == log.miss_bin[r]),
+                             log.default_left[r], go)
+
+        if has_categorical:
+            num_bin = log.go_left.shape[1]
+
+            def go_categorical(col):
+                oh = (col[:, None]
+                      == jnp.arange(num_bin, dtype=jnp.int32)[None, :])
+                return (oh.astype(jnp.float32)
+                        @ log.go_left[r].astype(jnp.float32)) > 0.5
+
+            # only the winning branch runs: numerical rounds skip the
+            # O(N*B) one-hot entirely
+            go = jax.lax.cond(log.kind[r] > 0, go_categorical, go_numerical,
+                              col)
+        else:
+            go = go_numerical(col)
+        upd = jnp.where((row_leaf == leaf) & ~go, r + 1, row_leaf)
         return jnp.where(active, upd, row_leaf)
 
     return jax.lax.fori_loop(0, max_splits, body, row_leaf)
 
 
-def _use_pallas(num_bin: int) -> bool:
-    import os
-    # the Pallas kernel is currently VPU-bound and loses to the bandwidth-
-    # bound einsum path on v5e; opt in while it is being tuned
-    if not os.environ.get("LGB_TPU_ENABLE_PALLAS"):
-        return False
-    from .ops.hist_pallas import pallas_available
-    return pallas_available(num_bin)
+def leaf_values_by_row(leaf_value: jax.Array, row_leaf: jax.Array,
+                       num_leaves: int, chunk: int = 65536) -> jax.Array:
+    """(L,) leaf outputs + (N,) leaf ids -> (N,) per-row values.
+
+    TPU element gathers run at ~60ns/row (latency-bound); a chunked one-hot
+    contraction is bandwidth-bound instead (~50x faster at N=2M). Exact:
+    f32 HIGHEST matmul with a 0/1 operand.
+    """
+    n = row_leaf.shape[0]
+    pad = (-n) % chunk
+    rl = jnp.pad(row_leaf, (0, pad)) if pad else row_leaf
+    iota = jnp.arange(num_leaves, dtype=rl.dtype)
+    lv = leaf_value.astype(jnp.float32)
+
+    def one(rl_c):
+        oh = (rl_c[:, None] == iota[None, :]).astype(jnp.float32)
+        return jax.lax.dot(oh, lv[:, None],
+                           precision=jax.lax.Precision.HIGHEST,
+                           preferred_element_type=jnp.float32)[:, 0]
+
+    if pad == 0 and n <= chunk:
+        return one(rl)
+    out = jax.lax.map(one, rl.reshape(-1, chunk))
+    return out.reshape(-1)[:n]
 
 
 # --------------------------------------------------------------------------
@@ -382,7 +697,7 @@ class SerialTreeLearner:
         self.num_leaves = max(2, int(config.num_leaves))
         nb = dataset.feature_num_bins()
         self.num_bin = int(max(2, nb.max() if len(nb) else 2))
-        from .ops.binning import BIN_CATEGORICAL, MISSING_NAN
+        from .ops.binning import BIN_CATEGORICAL, MISSING_NAN, MISSING_ZERO
         mono = np.zeros(dataset.num_features, dtype=np.int8)
         if dataset.monotone_constraints is not None:
             mono = dataset.monotone_constraints.astype(np.int8)
@@ -391,8 +706,9 @@ class SerialTreeLearner:
             pen = dataset.feature_penalty.astype(np.float32)
         self.meta = FeatureMeta(
             num_bins=jnp.asarray(nb, jnp.int32),
-            nan_missing=jnp.asarray(
-                [m.missing_type == MISSING_NAN and m.bin_type != BIN_CATEGORICAL
+            movable_missing=jnp.asarray(
+                [m.missing_type in (MISSING_NAN, MISSING_ZERO)
+                 and m.bin_type != BIN_CATEGORICAL
                  for m in dataset.bin_mappers], bool),
             missing_bin=jnp.asarray([m.missing_bin for m in dataset.bin_mappers], jnp.int32),
             is_categorical=jnp.asarray(
@@ -418,13 +734,32 @@ class SerialTreeLearner:
         )
         self.bins = jnp.asarray(dataset.binned)
         self.comm = Comm(comm_axis)
-        self._build = jax.jit(partial(build_tree, **self.build_kwargs()))
+        self._build = jax.jit(self.make_build_fn())
+
+    def use_partition(self) -> bool:
+        """Partitioned (leaf-contiguous) builder unless disabled or the bin
+        count exceeds the packed-u8 layout (max_bin > 256 -> u16 bins)."""
+        mode = self.config.tree_builder
+        if mode == "dense":
+            return False
+        ok = self.num_bin <= 256 and self.bins.dtype == jnp.uint8
+        if mode == "partition" and not ok:
+            Log.fatal(
+                "tree_builder=partition requires max_bin <= 256 (uint8 "
+                "bins); got %d bins. Use tree_builder=dense or lower "
+                "max_bin.", self.num_bin)
+        return ok
+
+    def make_build_fn(self):
+        """The tree-builder callable with static arguments closed over —
+        shared by the serial, data-parallel and fused training paths."""
+        if self.use_partition():
+            return partial(build_tree_partitioned, **self.build_kwargs())
+        return partial(build_tree, **self.build_kwargs())
 
     def build_kwargs(self) -> dict:
-        """Static arguments shared by the serial, data-parallel and fused
-        builders."""
         config = self.config
-        return dict(
+        kw = dict(
             hp=self.hp,
             num_leaves=self.num_leaves,
             num_bin=self.num_bin,
@@ -432,14 +767,23 @@ class SerialTreeLearner:
             feature_fraction_bynode=float(config.feature_fraction_bynode),
             extra_trees=bool(config.extra_trees),
             comm=self.comm,
-            hist_chunk=min(int(config.tpu_rows_per_chunk), 8192),
             constraint_sets=self._constraint_sets(),
             forced=self._forced_splits(),
-            use_pallas=_use_pallas(self.num_bin),
-            # measured on v5e: XLA fuses the f32 HIGHEST one-hot matmul better
-            # than the bf16 hi/lo two-dot variant (see ops/histogram.py)
-            mxu_bf16=False,
         )
+        if self.use_partition():
+            kw.update(
+                hist_chunk=int(config.tpu_hist_chunk),
+                part_chunk=int(config.tpu_part_chunk),
+                hist_exact=config.tpu_hist_precision != "bf16",
+            )
+        else:
+            kw.update(
+                hist_chunk=min(int(config.tpu_rows_per_chunk), 8192),
+                # measured on v5e: XLA fuses the f32 HIGHEST one-hot matmul
+                # better than the bf16 hi/lo two-dot variant
+                mxu_bf16=False,
+            )
+        return kw
 
     def _constraint_sets(self) -> Optional[jax.Array]:
         """Parse interaction_constraints "[0,1],[2,3]" into (S, F) bool over

@@ -29,21 +29,38 @@ def _weighted(grad, hess, weight):
 
 def _percentile_weighted(values: np.ndarray, weights: Optional[np.ndarray],
                          alpha: float) -> float:
-    """Weighted alpha-percentile (reference: PercentileFun / WeightedPercentileFun
-    in regression_objective.hpp)."""
-    if len(values) == 0:
+    """Weighted alpha-percentile with the reference's interpolation semantics
+    (reference: regression_objective.hpp:18 PercentileFun, :50
+    WeightedPercentileFun — including its boundary quirks)."""
+    n = len(values)
+    if n == 0:
         return 0.0
-    order = np.argsort(values)
-    v = values[order]
+    if n <= 1:
+        return float(values[0])
     if weights is None:
-        w = np.ones_like(v)
-    else:
-        w = weights[order]
-    cw = np.cumsum(w)
-    cutoff = alpha * cw[-1]
-    idx = int(np.searchsorted(cw, cutoff))
-    idx = min(idx, len(v) - 1)
-    return float(v[idx])
+        v = np.sort(values)
+        float_pos = (1.0 - alpha) * n
+        pos = int(float_pos)
+        if pos < 1:
+            return float(v[-1])
+        if pos >= n:
+            return float(v[0])
+        bias = float_pos - pos
+        v1 = v[n - pos]          # pos-th largest
+        v2 = v[n - 1 - pos]      # (pos+1)-th largest
+        return float(v1 - (v1 - v2) * bias)
+    order = np.argsort(values, kind="stable")
+    v = values[order]
+    cw = np.cumsum(weights[order].astype(np.float64))
+    threshold = alpha * cw[-1]
+    pos = int(np.searchsorted(cw, threshold, side="right"))
+    pos = min(pos, n - 1)
+    if pos == 0 or pos == n - 1:
+        return float(v[pos])
+    v1, v2 = float(v[pos - 1]), float(v[pos])
+    if cw[pos + 1] - cw[pos] >= 1.0:
+        return (threshold - cw[pos]) / (cw[pos + 1] - cw[pos]) * (v2 - v1) + v1
+    return v2
 
 
 class ObjectiveFunction:
@@ -67,6 +84,10 @@ class ObjectiveFunction:
             if metadata.label is not None else None
         self.weight = jnp.asarray(metadata.weight, jnp.float32) \
             if metadata.weight is not None else None
+
+    # objectives that draw per-iteration randomness take a traced iteration
+    # index in get_gradients (see RankXENDCG)
+    needs_iter = False
 
     def get_gradients(self, score: jax.Array) -> Tuple[jax.Array, jax.Array]:
         raise NotImplementedError
@@ -578,6 +599,7 @@ class RankXENDCG(ObjectiveFunction):
     distribution and the score softmax, per query."""
     name = "rank_xendcg"
     is_ranking = True
+    needs_iter = True
 
     def init(self, metadata: Metadata) -> None:
         super().init(metadata)
@@ -591,12 +613,13 @@ class RankXENDCG(ObjectiveFunction):
         phi = (2.0 ** lab - 1.0)
         self.q_phi = jnp.where(self.doc_valid,
                                jnp.asarray(phi, jnp.float32)[self.safe_idx], 0.0)
-        self._iter = 0
         self.key = jax.random.PRNGKey(int(self.config.objective_seed or 5))
 
-    def get_gradients(self, score):
-        key = jax.random.fold_in(self.key, self._iter)
-        self._iter += 1
+    def get_gradients(self, score, it=0):
+        # ``it`` is a traced iteration index threaded by the boosting loop so
+        # each iteration draws a fresh Gumbel perturbation even under jit
+        # (a host-side counter would be baked in at trace time)
+        key = jax.random.fold_in(self.key, jnp.asarray(it, jnp.int32))
         s = jnp.where(self.doc_valid, score[self.safe_idx], -jnp.inf)
         # sampled relevance distribution: softmax(phi + gumbel)
         gumbel = jax.random.gumbel(key, s.shape)

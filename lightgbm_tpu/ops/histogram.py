@@ -48,7 +48,7 @@ def _hist_chunk(bins_c: jax.Array, ghc_c: jax.Array, num_bins: int,
     onehot = (bins_c[:, :, None] == iota).reshape(chunk, num_feat * num_bins)
     if mxu_bf16:
         oh = onehot.astype(jnp.bfloat16)
-        hi = ghc_c.astype(jnp.bfloat16)
+        hi = jax.lax.optimization_barrier(ghc_c.astype(jnp.bfloat16))
         lo = (ghc_c - hi.astype(jnp.float32)).astype(jnp.bfloat16)
         out = jax.lax.dot(hi.T, oh, preferred_element_type=jnp.float32)
         out = out + jax.lax.dot(lo.T, oh, preferred_element_type=jnp.float32)
@@ -110,3 +110,109 @@ def build_histogram_np(bins: np.ndarray, ghc: np.ndarray, num_bins: int) -> np.n
 def build_histogram_jit(bins, ghc, num_bins: int, chunk: int = DEFAULT_CHUNK,
                         mxu_bf16: bool = False):
     return build_histogram(bins, ghc, num_bins, chunk, mxu_bf16)
+
+
+# ---------------------------------------------------------------------------
+# Segment histogram (partitioned learner path)
+# ---------------------------------------------------------------------------
+#
+# With rows kept leaf-contiguous (ops/partition.py), a leaf histogram reads
+# exactly the child's segment — the reference's O(rows_in_leaf) contract
+# (dense_bin.hpp:98). The direct one-hot matmul wastes the MXU (3-wide
+# output) and materializes (rows, F*B) one-hots; instead the bin id is
+# decomposed b = 16*hi + lo and the histogram factorizes as
+#   H[f,hi,lo,c] = sum_n HiOH[n,f,hi] * (LoOH[n,f,lo] * ch[n,c])
+# — a feature-batched einsum whose operands are (rows, F, 16) and
+# (rows, F, 16*NCH): ~B/16 = 16x less materialization than the direct form
+# (measured ~2-3x faster end to end on v5e, bounded by the VPU one-hot
+# build). Exactness: bf16 (hi, lo) channel splits make every product
+# exactly representable; the MXU accumulates f32 — the reference's GPU
+# f32-histogram precedent (docs/GPU-Performance.rst).
+
+LO_W = 16
+
+
+def _split_bf16(x):
+    # the barrier keeps XLA from folding the round-trip under
+    # --xla_allow_excess_precision (which would simplify lo to zero)
+    hi = jax.lax.optimization_barrier(x.astype(jnp.bfloat16))
+    lo = (x - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    return hi, lo
+
+
+def _mxu_dtype():
+    """bf16 operands on TPU (MXU accumulates f32 — verified pair-exact);
+    f32 elsewhere (XLA CPU accumulates bf16 dots in bf16, which would lose
+    the pair correction)."""
+    return jnp.bfloat16 if jax.default_backend() in ("tpu", "axon") \
+        else jnp.float32
+
+
+def _hist16_chunk(cb, cgm, num_bins: int, exact: bool):
+    """(C, F) u8 + (C, 3) f32 masked channels -> (F, SH, 16*NCH) f32."""
+    dt = _mxu_dtype()
+    sh = (num_bins + LO_W - 1) // LO_W
+    hi = (cb >> 4).astype(jnp.uint8)
+    lo = (cb & 15).astype(jnp.uint8)
+    hi_oh = (hi[:, :, None] == jnp.arange(sh, dtype=jnp.uint8)) \
+        .astype(dt)                                          # (C, F, SH)
+    lo_oh = (lo[:, :, None] == jnp.arange(LO_W, dtype=jnp.uint8))
+    if exact:
+        g_hi, g_lo = _split_bf16(cgm[:, 0])
+        h_hi, h_lo = _split_bf16(cgm[:, 1])
+        ch = jnp.stack([g_hi, g_lo, h_hi, h_lo,
+                        cgm[:, 2].astype(jnp.bfloat16)], axis=1)  # (C, 5)
+    else:
+        ch = cgm.astype(jnp.bfloat16)                        # (C, 3)
+    nch = ch.shape[1]
+    c, f = cb.shape
+    log_ = (lo_oh[:, :, :, None].astype(dt)
+            * ch[:, None, None, :].astype(dt)).reshape(c, f, LO_W * nch)
+    return jnp.einsum("cfh,cfx->fhx", hi_oh, log_,
+                      preferred_element_type=jnp.float32)
+
+
+def _hist16_combine(acc, num_bins: int, exact: bool):
+    f, sh, _ = acc.shape
+    nch = 5 if exact else 3
+    h = acc.reshape(f, sh, LO_W, nch).reshape(f, sh * LO_W, nch)[:, :num_bins]
+    if exact:
+        return jnp.stack([h[..., 0] + h[..., 1],
+                          h[..., 2] + h[..., 3], h[..., 4]], axis=-1)
+    return h
+
+
+def hist16_segment(work: jax.Array, plane, start, cnt, *,
+                   num_bins: int, num_feat: int, exact: bool = True,
+                   chunk: int = 2048) -> jax.Array:
+    """Histogram of physical rows [start, start+cnt) of ping-pong plane
+    ``plane`` -> (F, num_bins, 3).
+
+    work: (2, Npad, F+12) u8 packed working buffers (ops/partition.py
+    pack_rows): bins columns followed by (g, h, cnt) f32 bytes, already
+    bagging-masked. plane/start/cnt are traced scalars; one compilation
+    serves every leaf.
+    """
+    from .partition import unpack_ghc
+
+    f = num_feat
+    sh = (num_bins + LO_W - 1) // LO_W
+    nch = 5 if exact else 3
+    nchunks = (cnt + chunk - 1) // chunk
+    width = work.shape[2]
+
+    def body(i, acc):
+        off = start + i * chunk
+        cw = jax.lax.dynamic_slice(work, (plane, off, 0),
+                                   (1, chunk, width))[0]
+        cb = cw[:, :f]
+        cg = unpack_ghc(cw, f)
+        rows_left = cnt - i * chunk
+        valid = jnp.arange(chunk, dtype=jnp.int32) < rows_left
+        cgm = cg * valid[:, None].astype(jnp.float32)
+        return acc + _hist16_chunk(cb, cgm, num_bins, exact)
+
+    acc = jax.lax.fori_loop(
+        0, nchunks, body,
+        jnp.zeros((f, sh, LO_W * nch), jnp.float32))
+    return _hist16_combine(acc, num_bins, exact)

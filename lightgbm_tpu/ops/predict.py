@@ -35,7 +35,7 @@ class PackedTrees(NamedTuple):
 def pack_trees(trees: List, dataset, num_bin: int, num_class: int = 1) -> PackedTrees:
     """Build the packed arrays from host Tree models + the dataset's bin
     mappers (bin tables absorb threshold/categorical/missing semantics)."""
-    from ..ops.binning import BIN_CATEGORICAL, MISSING_NAN
+    from ..ops.binning import BIN_CATEGORICAL, MISSING_NAN, MISSING_ZERO
     T = len(trees)
     L = max((t.num_leaves for t in trees), default=1)
     I = max(L - 1, 1)
@@ -75,7 +75,7 @@ def pack_trees(trees: List, dataset, num_bin: int, num_class: int = 1) -> Packed
                     tbin = int(np.searchsorted(ub, thr, side="left"))
                     tbin = min(tbin, mapper.num_bins - 1)
                     tbl = b_iota <= tbin
-                    if mapper.missing_type == MISSING_NAN \
+                    if mapper.missing_type in (MISSING_NAN, MISSING_ZERO) \
                             and mapper.bin_type != BIN_CATEGORICAL:
                         tbl = tbl.copy()
                         tbl[mapper.missing_bin] = bool(t.decision_type[nd] & 2)

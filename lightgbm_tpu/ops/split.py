@@ -35,7 +35,9 @@ KIND_CAT_MVM_DESC = 3
 class FeatureMeta(NamedTuple):
     """Per-feature static metadata as device arrays (F,)."""
     num_bins: jax.Array        # int32 total bins incl. missing bin
-    nan_missing: jax.Array     # bool: last bin is a dedicated NaN bin
+    movable_missing: jax.Array # bool: feature has a bin routed with the
+                               # missing direction (NaN bin for MISSING_NAN,
+                               # zero/default bin for MISSING_ZERO)
     missing_bin: jax.Array     # int32 index of the NaN bin (num_bins-1) or 0
     is_categorical: jax.Array  # bool
     monotone: jax.Array        # int8 in {-1, 0, +1}
@@ -158,7 +160,7 @@ def find_best_split(
     parent_gain = leaf_objective_value(parent_sum[0], parent_sum[1], hp)
 
     # ---------- numerical thresholds ----------
-    is_missing_bin = meta.nan_missing[:, None] & (b_iota[None, :] == meta.missing_bin[:, None])
+    is_missing_bin = meta.movable_missing[:, None] & (b_iota[None, :] == meta.missing_bin[:, None])
     miss = jnp.sum(jnp.where(is_missing_bin[:, :, None], hist, 0.0), axis=1)   # (F, 3)
     hist_nm = jnp.where(is_missing_bin[:, :, None], 0.0, hist)
     cum = jnp.cumsum(hist_nm, axis=1)                                # (F, B, 3)
@@ -180,7 +182,7 @@ def find_best_split(
     gain_dr = eval_dir(cum)                                  # missing -> right
     gain_dl = eval_dir(cum + miss[:, None, :])               # missing -> left
     # nothing to gain from dl when there is no missing mass; keep dr on ties
-    gain_dl = jnp.where(meta.nan_missing[:, None], gain_dl, NEG_INF)
+    gain_dl = jnp.where(meta.movable_missing[:, None], gain_dl, NEG_INF)
     t_valid = (b_iota[None, :] < meta.num_bins[:, None] - 1) & ~meta.is_categorical[:, None]
     if rand_threshold is not None:
         # extra-trees: only one random threshold per feature is considered
@@ -264,7 +266,7 @@ def find_best_split(
     def tbl_numerical():
         base = b_iota <= tbin
         dl = num_dl[feat, tbin]
-        base = jnp.where(meta.nan_missing[feat] & (b_iota == meta.missing_bin[feat]),
+        base = jnp.where(meta.movable_missing[feat] & (b_iota == meta.missing_bin[feat]),
                          dl, base)
         return base, dl
 

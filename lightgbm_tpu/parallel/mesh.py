@@ -29,7 +29,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..config import Config
 from ..dataset import BinnedDataset
-from ..learner import Comm, SerialTreeLearner, TreeLog, build_tree
+from ..learner import Comm, SerialTreeLearner, TreeLog
 
 DATA_AXIS = "data"
 
@@ -63,16 +63,15 @@ class DataParallelTreeLearner(SerialTreeLearner):
         self.rep_sharding = NamedSharding(mesh, P())
         self.bins = jax.device_put(jnp.asarray(bins_np), self.row_sharding)
 
-        kw = self.build_kwargs()
-        kw["comm"] = Comm(DATA_AXIS)
-        inner = partial(build_tree, **kw)
+        inner = self.make_build_fn()
         sharded = jax.shard_map(
             inner, mesh=mesh,
             in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(), P(), P()),
             out_specs=TreeLog(
                 num_splits=P(), split_leaf=P(), feature=P(), bin=P(), kind=P(),
                 default_left=P(), gain=P(), left_sum=P(), right_sum=P(),
-                go_left=P(), leaf_value=P(), leaf_sum=P(), row_leaf=P(DATA_AXIS)),
+                go_left=P(), miss_bin=P(), movable=P(), leaf_value=P(),
+                leaf_sum=P(), row_leaf=P(DATA_AXIS)),
             check_vma=False,
         )
         self._build = jax.jit(sharded)

@@ -141,8 +141,8 @@ class Tree:
 
         Mirrors reference NumericalDecision / CategoricalDecision
         (tree.h:133-166): missing handling None (NaN->0), Zero (NaN->0 and
-        |x|<=kZeroThreshold treated by threshold compare), NaN (default dir).
-        Returns bool array: True -> go left.
+        |x|<=kZeroThreshold routed to the default direction), NaN (default
+        dir). Returns bool array: True -> go left.
         """
         dt = int(self.decision_type[node])
         if dt & K_CATEGORICAL_MASK:
@@ -156,9 +156,12 @@ class Tree:
         if missing_type == 2:  # NaN-aware
             base = values <= thr
             return np.where(nan_mask, default_left, base)
-        # None/Zero: NaN behaves as 0 (reference tree.h:133 converts)
+        # None/Zero: NaN behaves as 0 (reference tree.h NumericalDecision)
         v = np.where(nan_mask, 0.0, values)
-        return v <= thr
+        base = v <= thr
+        if missing_type == 1:  # zero as missing: zeros take the default dir
+            return np.where(np.abs(v) <= 1e-35, default_left, base)
+        return base
 
     def predict(self, X: np.ndarray) -> np.ndarray:
         """Batch prediction of leaf outputs for raw feature rows."""

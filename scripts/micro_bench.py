@@ -1,0 +1,100 @@
+"""Microbenchmarks for the round-2 histogram/partition design (TPU).
+
+Run on the real chip: python scripts/micro_bench.py
+Measures the primitives the partitioned learner is built from:
+  - full-N one-hot histogram (f32 HIGHEST vs bf16 hi/lo einsum)
+  - pallas histogram kernel
+  - row gather (index list -> (C, F) slab)
+  - compaction (mask -> packed index list) via cumsum+scatter
+  - argsort-based compaction for comparison
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def timeit(fn, *args, iters=5, warmup=2):
+    for _ in range(warmup):
+        r = fn(*args)
+    jax.block_until_ready(r)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        r = fn(*args)
+    jax.block_until_ready(r)
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    print("devices:", jax.devices())
+    N, F, B = 2_000_000, 28, 256
+    rng = np.random.RandomState(0)
+    bins = jnp.asarray(rng.randint(0, B, size=(N, F)), jnp.uint8)
+    ghc = jnp.asarray(rng.randn(N, 3), jnp.float32)
+    row_leaf = jnp.asarray(rng.randint(0, 8, size=(N,)), jnp.int32)
+
+    from lightgbm_tpu.ops.histogram import build_histogram_jit
+
+    for mxu_bf16 in (False, True):
+        for chunk in (2048, 8192, 32768):
+            t = timeit(build_histogram_jit, bins, ghc, B, chunk, mxu_bf16)
+            flops = N * F * B * 3 * 2
+            print(f"einsum bf16={mxu_bf16} chunk={chunk}: {t*1e3:.1f} ms "
+                  f"({N/t/1e6:.1f} M rows/s, {flops/t/1e12:.2f} eff TFLOP/s)")
+
+    # gather a compacted chunk
+    idx = jnp.asarray(rng.randint(0, N, size=(16384,)), jnp.int32)
+
+    @jax.jit
+    def gather(idx):
+        return bins[idx], ghc[idx]
+
+    t = timeit(gather, idx)
+    print(f"gather 16384 rows: {t*1e6:.0f} us ({16384/t/1e6:.1f} M rows/s)")
+
+    # compaction: mask -> packed indices
+    mask = row_leaf == 3
+
+    @jax.jit
+    def compact_scatter(mask):
+        pos = jnp.cumsum(mask.astype(jnp.int32)) - 1
+        cnt = pos[-1] + 1
+        buf = jnp.zeros((N,), jnp.int32)
+        buf = buf.at[jnp.where(mask, pos, N)].set(
+            jnp.arange(N, dtype=jnp.int32), mode="drop")
+        return buf, cnt
+
+    t = timeit(compact_scatter, mask)
+    print(f"compact scatter N={N}: {t*1e3:.2f} ms")
+
+    @jax.jit
+    def compact_sort(mask):
+        return jnp.argsort(~mask, stable=True)
+
+    t = timeit(compact_sort, mask)
+    print(f"compact argsort N={N}: {t*1e3:.2f} ms")
+
+    @jax.jit
+    def just_cumsum(mask):
+        return jnp.cumsum(mask.astype(jnp.int32))
+
+    t = timeit(just_cumsum, mask)
+    print(f"cumsum N={N}: {t*1e3:.2f} ms")
+
+    # segment-local chunked partition cost model: gather + small ops per chunk
+    @jax.jit
+    def route(idx):
+        col = bins[idx, 5].astype(jnp.int32)
+        return col < 128
+
+    t = timeit(route, idx)
+    print(f"route 16384 rows: {t*1e6:.0f} us")
+
+
+if __name__ == "__main__":
+    main()

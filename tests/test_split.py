@@ -13,7 +13,7 @@ def _meta(num_bins, nan_missing=None, is_cat=None):
     cat = np.zeros(f, bool) if is_cat is None else np.asarray(is_cat)
     return FeatureMeta(
         num_bins=jnp.asarray(nb),
-        nan_missing=jnp.asarray(nanm),
+        movable_missing=jnp.asarray(nanm),
         missing_bin=jnp.asarray(np.where(nanm, nb - 1, 0).astype(np.int32)),
         is_categorical=jnp.asarray(cat),
         monotone=jnp.zeros(f, jnp.int8),
