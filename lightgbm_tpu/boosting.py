@@ -26,6 +26,19 @@ from .tree import Tree
 from .utils.log import Log
 
 
+from functools import partial as _partial
+
+
+@_partial(jax.jit, static_argnames=("class_id",), donate_argnums=(0,))
+def _score_add(score, lv, leaf_assign, scale, class_id):
+    """One fused launch per tree contribution (kept jitted: the eager form
+    retraced per op and dominated DART/rollback wall-clock)."""
+    vals = leaf_values_by_row(lv, leaf_assign, lv.shape[0]) * scale
+    if score.ndim > 1:
+        return score.at[:, class_id].add(vals)
+    return score + vals
+
+
 class ScoreTracker:
     """Running raw scores for one dataset (reference: score_updater.hpp:21)."""
 
@@ -36,13 +49,10 @@ class ScoreTracker:
         self.score = jnp.asarray(s)
 
     def add(self, leaf_values: np.ndarray, leaf_assign: jax.Array, class_id: int,
-            num_class: int) -> None:
+            num_class: int, scale: float = 1.0) -> None:
         lv = jnp.asarray(leaf_values, jnp.float32)
-        vals = leaf_values_by_row(lv, leaf_assign, lv.shape[0])
-        if num_class > 1:
-            self.score = self.score.at[:, class_id].add(vals)
-        else:
-            self.score = self.score + vals
+        self.score = _score_add(self.score, lv, leaf_assign,
+                                jnp.float32(scale), int(class_id))
 
     def np(self) -> np.ndarray:
         return np.asarray(self.score)
@@ -136,51 +146,48 @@ class GBDT:
             bins = jnp.asarray(valid_set.binned)
             Log.debug("Replaying %d trees onto valid set %s", len(self.models), name)
             for i, tree in enumerate(self.models):
-                leaf = self._route_tree_host(tree, valid_set)
-                vs.add(tree.leaf_value, jnp.asarray(leaf), i % self.num_tree_per_iteration,
+                vals, leaf = self._route_tree_device(tree, valid_set)
+                vs.add(vals, leaf, i % self.num_tree_per_iteration,
                        self.num_tree_per_iteration)
         self.valid_sets.append((name, valid_set, vs))
 
-    def _route_tree_host(self, tree: Tree, ds: BinnedDataset) -> np.ndarray:
-        # route binned rows through a host Tree via bin tables
-        # (rarely used: only for continued-training valid replay)
-        raise_if = tree.num_leaves
-        del raise_if
-        # fall back to raw-value prediction is not possible (no raw data kept);
-        # use bin-threshold routing
-        n = ds.num_data
-        node = np.zeros(n, dtype=np.int64)
-        binned = ds.binned
-        active = node >= 0
-        from .ops.binning import BIN_CATEGORICAL
-        while np.any(active):
-            for nd in np.unique(node[active]):
-                sel = active & (node == nd)
-                real_f = tree.split_feature[nd]
-                inner = ds.inner_feature_index(int(real_f))
-                mapper = ds.bin_mappers[inner]
-                bvals = binned[sel, inner].astype(np.int64)
-                if tree.decision_type[nd] & 1:
-                    cats = tree.cat_threshold.get(int(nd), np.array([], dtype=np.int64))
-                    cat_of_bin = np.full(mapper.num_bins, -1, dtype=np.int64)
-                    for b in range(len(mapper.categories)):
-                        cat_of_bin[b] = mapper.categories[b]
-                    go_left = np.isin(cat_of_bin[bvals], cats)
-                else:
-                    # derive the threshold bin from the real-valued threshold
-                    # so text-loaded models (which carry no bin ids) route
-                    # identically (analog of ValueToBin, bin.h:464)
-                    thr = float(tree.threshold[nd])
-                    thr_bin = int(np.searchsorted(mapper.upper_bounds, thr,
-                                                  side="left"))
-                    thr_bin = min(thr_bin, mapper.num_bins - 1)
-                    go_left = bvals <= thr_bin
-                    if mapper.missing_type in (1, 2):  # Zero or NaN missing
-                        dl = bool(tree.decision_type[nd] & 2)
-                        go_left = np.where(bvals == mapper.missing_bin, dl, go_left)
-                node[sel] = np.where(go_left, tree.left_child[nd], tree.right_child[nd])
-            active = node >= 0
-        return (~node).astype(np.int32)
+    def _route_tree_device(self, tree: Tree, ds: BinnedDataset):
+        """Route a dataset's binned rows through a host Tree on device.
+
+        Converts the tree into leaf-slot split order (bin-space thresholds)
+        and reuses the learner's arithmetic router — replaces the round-1
+        per-node Python walk that made DART/rollback quadratic (reference
+        analogs: score_updater.hpp, dart.hpp score replay). Returns
+        (slot-ordered leaf values (L,), per-row slots (N,) device array).
+        """
+        from .ops.predict import tree_to_bin_log
+
+        # logs are cached per (tree state, dataset): DART re-drops the same
+        # trees every iteration and each conversion costs host work plus
+        # ~a dozen host->device uploads
+        cache = getattr(self, "_tree_log_cache", None)
+        if cache is None:
+            cache = self._tree_log_cache = {}
+        key = (id(tree), tree.leaf_value.tobytes(), id(ds))
+        log = cache.get(key)
+        if log is None:
+            if len(cache) > 4096:
+                cache.clear()
+            log = cache[key] = tree_to_bin_log(tree, ds)
+        if ds is self.train_set and self.learner is not None:
+            bins = self.learner.bins
+            bundle = self.learner.bundle
+            hc = self.learner.hp.has_categorical
+        else:
+            bins = self._valid_bins(ds)
+            bundle = None
+            if ds.has_bundles:
+                bundle = {k: jnp.asarray(v)
+                          for k, v in ds.bundle_maps().items()}
+            from .ops.binning import BIN_CATEGORICAL
+            hc = any(m.bin_type == BIN_CATEGORICAL for m in ds.bin_mappers)
+        leaf = assign_leaves(bins, log, has_categorical=hc, bundle=bundle)
+        return np.asarray(log.leaf_value), leaf
 
     # --------------------------------------------------------------- sampling
     def _bagging(self, it: int, grad: jax.Array, hess: jax.Array) -> None:
@@ -309,7 +316,10 @@ class GBDT:
                                  self.num_tree_per_iteration)
             for _, vset, vscore in self.valid_sets:
                 vbins = self._valid_bins(vset)
-                vleaf = assign_leaves(vbins, log)
+                vleaf = assign_leaves(
+                    vbins, log,
+                    has_categorical=self.learner.hp.has_categorical,
+                    bundle=self.learner.bundle)
                 vscore.add(leaf_vals_dev, vleaf, class_id,
                            self.num_tree_per_iteration)
         return tree
@@ -365,15 +375,15 @@ class GBDT:
 
         ts = fresh_tracker(self.train_set)
         for i, tree in enumerate(self.models):
-            leaf = self._route_tree_host(tree, self.train_set)
-            ts.add(tree.leaf_value, jnp.asarray(leaf), i % K, K)
+            vals, leaf = self._route_tree_device(tree, self.train_set)
+            ts.add(vals, leaf, i % K, K)
         self.train_score = ts
         rebuilt = []
         for name, vset, _ in self.valid_sets:
             vs = fresh_tracker(vset)
             for i, tree in enumerate(self.models):
-                leaf = self._route_tree_host(tree, vset)
-                vs.add(tree.leaf_value, jnp.asarray(leaf), i % K, K)
+                vals, leaf = self._route_tree_device(tree, vset)
+                vs.add(vals, leaf, i % K, K)
             rebuilt.append((name, vset, vs))
         self.valid_sets = rebuilt
 
@@ -408,6 +418,37 @@ class GBDT:
         return out
 
     # ---------------------------------------------------------------- predict
+    DEVICE_PREDICT_MIN_ROWS = 512
+
+    def _raw_scores(self, X: np.ndarray, start: int, end: int) -> np.ndarray:
+        """Ensemble raw scores (N, K) over model range [start*K, end*K).
+
+        Large batches route on device (reference analog:
+        src/application/predictor.hpp batch prediction); small batches walk
+        the host trees — a device launch costs ~100 ms behind the tunnel.
+        """
+        K = self.num_tree_per_iteration
+        n = X.shape[0]
+        models = self.models[start * K:end * K]
+        if n >= self.DEVICE_PREDICT_MIN_ROWS and models:
+            from .ops.predict import pack_splits, predict_raw
+
+            key = (start, end, len(self.models),
+                   id(self.models[-1]) if self.models else 0)
+            cached = getattr(self, "_pack_cache", None)
+            if cached is None or cached[0] != key:
+                pack, has_cat = pack_splits(models, num_class=K)
+                self._pack_cache = (key, pack, has_cat)
+            _, pack, has_cat = self._pack_cache
+            score = predict_raw(jnp.asarray(X, jnp.float32), pack,
+                                num_class=K, has_cat=has_cat)
+            out = np.asarray(score, np.float64)
+            return out.reshape(n, K) if K > 1 else out[:, None]
+        score = np.zeros((n, K), dtype=np.float64)
+        for i, t in enumerate(models):
+            score[:, (start * K + i) % K] += t.predict(X)
+        return score
+
     def predict(self, X: np.ndarray, *, raw_score: bool = False,
                 start_iteration: int = 0, num_iteration: int = -1,
                 pred_leaf: bool = False) -> np.ndarray:
@@ -423,10 +464,8 @@ class GBDT:
             for i in range(start_iteration * K, end * K):
                 out[:, i - start_iteration * K] = self.models[i].predict_leaf_index(X)
             return out
-        score = np.zeros((n, K), dtype=np.float64)
-        score += self.init_scores[None, :K]
-        for i in range(start_iteration * K, end * K):
-            score[:, i % K] += self.models[i].predict(X)
+        score = self._raw_scores(X, start_iteration, end)
+        score = score + self.init_scores[None, :K]
         if not raw_score and self.objective is not None:
             score = np.asarray(self.objective.convert_output(jnp.asarray(score)))
         if K == 1:
@@ -501,6 +540,9 @@ class GBDT:
         model.init_scores = np.asarray([float(v) for v in init], dtype=np.float64)
         model.best_iteration = int(kv.get("best_iteration", -1))
         model.objective = create_objective(config)
+        # default metrics follow the objective so a loaded model can
+        # evaluate valid sets (reference: metric defaults from objective)
+        model.metrics = create_metrics(config, model.objective.name)
         model._feature_names = kv.get("feature_names", "").split()
         body = "Tree=" + rest
         for block in body.split("Tree=")[1:]:
@@ -625,14 +667,13 @@ class DART(GBDT):
 
     def _apply_tree_delta(self, tree: Tree, class_id: int, scale: float) -> None:
         """Add ``scale`` × tree's contribution to train/valid scores."""
-        leaf_vals = tree.leaf_value * scale
-        leaf = self._route_tree_host(tree, self.train_set)
-        self.train_score.add(leaf_vals, jnp.asarray(leaf), class_id,
-                             self.num_tree_per_iteration)
+        vals, leaf = self._route_tree_device(tree, self.train_set)
+        self.train_score.add(vals, leaf, class_id,
+                             self.num_tree_per_iteration, scale=scale)
         for _, vset, vscore in self.valid_sets:
-            vleaf = self._route_tree_host(tree, vset)
-            vscore.add(leaf_vals, jnp.asarray(vleaf), class_id,
-                       self.num_tree_per_iteration)
+            vvals, vleaf = self._route_tree_device(tree, vset)
+            vscore.add(vvals, vleaf, class_id,
+                       self.num_tree_per_iteration, scale=scale)
 
 
 class RF(GBDT):
@@ -690,7 +731,10 @@ class RF(GBDT):
                 / (it + 1)
             self.train_score.score = new + self.init_scores[0]
         for _, vset, vscore in self.valid_sets:
-            vleaf = assign_leaves(self._valid_bins(vset), log)
+            vleaf = assign_leaves(
+                self._valid_bins(vset), log,
+                has_categorical=self.learner.hp.has_categorical,
+                bundle=self.learner.bundle)
             lv = jnp.asarray(tree.leaf_value, jnp.float32)
             vals = leaf_values_by_row(lv, vleaf, lv.shape[0])
             if self.num_class > 1:
@@ -715,12 +759,9 @@ class RF(GBDT):
             return super().predict(X, raw_score=raw_score,
                                    start_iteration=start_iteration,
                                    num_iteration=num_iteration, pred_leaf=True)
-        score = np.zeros((n, K), dtype=np.float64)
         cnt = max(1, end - start_iteration)
-        for i in range(start_iteration * K, end * K):
-            score[:, i % K] += self.models[i].predict(X)
-        score /= cnt
-        score += self.init_scores[None, :K]
+        score = self._raw_scores(X, start_iteration, end) / cnt
+        score = score + self.init_scores[None, :K]
         if not raw_score and self.objective is not None:
             score = np.asarray(self.objective.convert_output(jnp.asarray(score)))
         return score.ravel() if K == 1 else score

@@ -131,6 +131,71 @@ class BinnedDataset:
         except ValueError:
             return -1
 
+    @property
+    def num_groups(self) -> int:
+        return len(self.groups)
+
+    @property
+    def has_bundles(self) -> bool:
+        return any(len(g.feature_indices) > 1 for g in self.groups)
+
+    def group_num_bins(self) -> np.ndarray:
+        return np.array([g.num_bins for g in self.groups], dtype=np.int32)
+
+    def bundle_maps(self) -> Dict[str, np.ndarray]:
+        """Static index maps between feature-bin space and bundle-bin space,
+        used by the learner to reconstruct per-feature histogram views from
+        bundled columns and to translate routing tables (reference analog:
+        FeatureGroup bin offsets + Dataset::FixHistogram, dataset.h:503).
+
+        - proj (F, B): flat index into (num_groups * Bm) for each feature bin
+          (meaningless where ``valid`` is False)
+        - valid (F, B): feature bin has its own bundle slot (False for the
+          shared default bin of multi-bundles and past num_bins)
+        - has_rest (F,): feature lives in a multi-feature bundle — its
+          default bin must be recovered as parent_total - sum(own slots)
+        - dpos (F,): the feature's default bin index
+        - map_fb (F, Bm): bundle bin -> this feature's bin (its default bin
+          for bundle bins belonging to other sub-features / shared zero)
+        - group (F,), offset (F,), nbm1 (F,): routing arithmetic inputs
+        """
+        F = self.num_features
+        B = int(self.feature_num_bins().max()) if F else 1
+        Bm = int(self.group_num_bins().max()) if self.groups else 1
+        proj = np.zeros((F, B), np.int32)
+        valid = np.zeros((F, B), bool)
+        has_rest = np.zeros(F, bool)
+        dpos = np.zeros(F, np.int32)
+        map_fb = np.zeros((F, Bm), np.int32)
+        nbm1 = np.zeros(F, np.int32)
+        for gid, grp in enumerate(self.groups):
+            multi = len(grp.feature_indices) > 1
+            for j, off in zip(grp.feature_indices, grp.bin_offsets):
+                m = self.bin_mappers[j]
+                nb = m.num_bins
+                d = m.default_bin if multi else -1
+                dpos[j] = m.default_bin
+                has_rest[j] = multi
+                nbm1[j] = nb - 1
+                bb_ids = np.arange(nb)
+                if multi:
+                    adj = bb_ids - (bb_ids > d)
+                    slots = np.where(bb_ids == d, 0, off + adj)
+                    proj[j, :nb] = gid * Bm + slots
+                    valid[j, :nb] = bb_ids != d
+                    map_fb[j, :] = m.default_bin
+                    own = np.arange(nb)[bb_ids != d]
+                    map_fb[j, off:off + nb - 1] = own
+                else:
+                    proj[j, :nb] = gid * Bm + bb_ids
+                    valid[j, :nb] = True
+                    map_fb[j, :min(nb, Bm)] = np.arange(min(nb, Bm))
+                    map_fb[j, nb:] = nb - 1
+        return dict(proj=proj, valid=valid, has_rest=has_rest, dpos=dpos,
+                    map_fb=map_fb, group=self.feature_to_group.astype(np.int32),
+                    offset=self.feature_group_offset.astype(np.int32),
+                    nbm1=nbm1)
+
 
 def _resolve_categorical(
     categorical_feature: Union[str, Sequence[Union[int, str]], None],
@@ -178,7 +243,9 @@ def construct_dataset(
     (validation sets must share the training set's binning —
     reference: LoadFromFileAlignWithOtherDataset, dataset_loader.cpp:261).
     """
-    X = np.asarray(X)
+    sparse = _is_sparse(X)
+    if not sparse:
+        X = np.asarray(X)
     if X.ndim != 2:
         raise ValueError("X must be 2-dimensional, got shape %s" % (X.shape,))
     num_data, num_total = X.shape
@@ -214,7 +281,28 @@ def construct_dataset(
         sample_idx.sort()
     else:
         sample_idx = np.arange(num_data)
-    X_sample = np.asarray(X[sample_idx], dtype=np.float64)
+    if sparse:
+        import scipy.sparse as sp
+        Xs_csc = sp.csc_matrix(sp.csr_matrix(X)[sample_idx])
+
+        def sample_col(f: int) -> np.ndarray:
+            # nonzeros only; find_bin counts the rest as implicit zeros
+            return np.asarray(
+                Xs_csc.data[Xs_csc.indptr[f]:Xs_csc.indptr[f + 1]], np.float64)
+
+        def sample_nz_mask(f: int) -> np.ndarray:
+            mask = np.zeros(sample_cnt, dtype=bool)
+            mask[Xs_csc.indices[Xs_csc.indptr[f]:Xs_csc.indptr[f + 1]]] = True
+            return mask
+    else:
+        X_sample = np.asarray(X[sample_idx], dtype=np.float64)
+
+        def sample_col(f: int) -> np.ndarray:
+            return X_sample[:, f]
+
+        def sample_nz_mask(f: int) -> np.ndarray:
+            col = X_sample[:, f]
+            return np.abs(np.nan_to_num(col, nan=1.0)) > 1e-35
 
     # per-feature max_bin override (reference: max_bin_by_feature, config.h)
     max_bin_by_feature = config.max_bin_by_feature
@@ -228,7 +316,7 @@ def construct_dataset(
     for f in range(num_total):
         mb = (max_bin_by_feature[f] if f < len(max_bin_by_feature) else config.max_bin)
         m = find_bin(
-            X_sample[:, f],
+            sample_col(f),
             sample_cnt,
             mb,
             config.min_data_in_bin,
@@ -248,7 +336,11 @@ def construct_dataset(
 
     # ---- EFB bundling decision (reference: dataset.cpp:239 FastFeatureBundling) ----
     ds.groups, ds.feature_to_group, ds.feature_group_offset = _make_groups(
-        ds, X_sample, used, mappers, enable_bundle=config.enable_bundle
+        sample_nz_mask, sample_cnt, used, mappers,
+        # bundles are capped at 256 bins so the matrix stays uint8; with
+        # max_bin > 256 single features already need uint16 — skip bundling
+        enable_bundle=config.enable_bundle and config.max_bin <= 256,
+        max_conflict_rate=float(getattr(config, "max_conflict_rate", 0.0)),
     )
     ds.max_bins_per_feature = max((g.num_bins for g in ds.groups), default=1)
 
@@ -273,8 +365,8 @@ def construct_dataset(
 
 
 def _make_groups(
-    ds: BinnedDataset,
-    X_sample: np.ndarray,
+    sample_nz_mask,
+    sample_cnt: int,
     used: List[int],
     mappers: List[BinMapper],
     *,
@@ -287,6 +379,8 @@ def _make_groups(
     Only sufficiently sparse features are bundling candidates; dense features
     get their own group. Conflicts are counted on the sample: two features
     conflict on a row if both are away from their most-frequent (default) bin.
+    A bundle's total bin count is capped at 256 so the training matrix stays
+    uint8 (the partitioned learner's packed-row layout).
     """
     n = len(used)
     sparse_ok = [enable_bundle and m.sparse_rate >= 0.8 and m.bin_type == BIN_NUMERICAL
@@ -302,26 +396,28 @@ def _make_groups(
     # nonzero masks on the sample for bundling candidates
     bundles: List[List[int]] = []
     bundle_masks: List[np.ndarray] = []
-    sample_total = X_sample.shape[0]
-    max_conflicts = int(max_conflict_rate * sample_total)
+    bundle_bins: List[int] = []
+    max_conflicts = int(max_conflict_rate * sample_cnt)
     for i in range(n):
         if not sparse_ok[i]:
             continue
-        col = X_sample[:, used[i]]
-        nz = np.abs(np.nan_to_num(col, nan=1.0)) > 1e-35
+        nz = sample_nz_mask(used[i])
+        nb = mappers[i].num_bins - 1    # bins it adds to a bundle
         placed = False
         for b, mask in enumerate(bundle_masks):
-            if len(bundles[b]) >= 255:
+            if len(bundles[b]) >= 255 or bundle_bins[b] + nb > 256:
                 continue
             conflicts = int(np.count_nonzero(mask & nz))
             if conflicts <= max_conflicts:
                 bundles[b].append(i)
                 bundle_masks[b] = mask | nz
+                bundle_bins[b] += nb
                 placed = True
                 break
         if not placed:
             bundles.append([i])
             bundle_masks.append(nz)
+            bundle_bins.append(1 + nb)
 
     # only multi-feature bundles count as bundles
     multi = [b for b in bundles if len(b) > 1]
@@ -351,20 +447,70 @@ def _make_groups(
     return groups, feature_to_group, feature_offset
 
 
-def _extract_binned(X: np.ndarray, ds: BinnedDataset) -> np.ndarray:
-    """Bin every row into the (num_data, num_features) matrix.
+def _bundle_bin(m: BinMapper, bins: np.ndarray, offset: int) -> np.ndarray:
+    """Map a sub-feature's bins into its bundle range.
 
-    NOTE on layout: the training matrix is per-used-feature (one column per
-    feature, not per group). EFB groups are honored at histogram time via
-    shared columns when beneficial; for the dense TPU path a plain
-    per-feature column keeps the one-hot histogram indexing uniform.
+    Non-default bins keep their order in [offset, offset + num_bins - 1);
+    the default (most-frequent/zero) bin maps to the bundle's shared bin 0
+    (reference: FeatureGroup bin offsets, include/LightGBM/feature_group.h:25).
+    """
+    d = m.default_bin
+    adj = bins - (bins > d).astype(bins.dtype)  # remove the default slot
+    return np.where(bins == d, 0, offset + adj)
+
+
+def _extract_binned(X, ds: BinnedDataset) -> np.ndarray:
+    """Bin every row into the (num_data, num_groups) bundled matrix.
+
+    EFB (reference: Dataset::Construct + FeatureGroup::PushData,
+    src/io/dataset.cpp:318): each group is one column; multi-feature
+    bundles share the column with per-sub-feature bin offsets, so histogram
+    and partition cost scale with the BUNDLED column count. Accepts dense
+    numpy or scipy sparse input; sparse stays O(nnz).
     """
     num_data = X.shape[0]
-    F = ds.num_features
-    max_bins = max((m.num_bins for m in ds.bin_mappers), default=1)
+    max_bins = max((g.num_bins for g in ds.groups), default=1)
     dtype = np.uint8 if max_bins <= 256 else np.uint16
-    out = np.zeros((num_data, F), dtype=dtype)
-    Xv = np.asarray(X, dtype=np.float64)
-    for i, (f, m) in enumerate(zip(ds.used_feature_indices, ds.bin_mappers)):
-        out[:, i] = m.value_to_bin(Xv[:, f]).astype(dtype)
+    out = np.zeros((num_data, len(ds.groups)), dtype=dtype)
+    sparse = _is_sparse(X)
+    if sparse:
+        import scipy.sparse as sp
+        Xc = sp.csc_matrix(X)
+    else:
+        Xv = np.asarray(X, dtype=np.float64)
+
+    for gid, grp in enumerate(ds.groups):
+        multi = len(grp.feature_indices) > 1
+        for j, off in zip(grp.feature_indices, grp.bin_offsets):
+            m = ds.bin_mappers[j]
+            real = ds.used_feature_indices[j]
+            if sparse:
+                col = Xc.getcol(real)
+                rows = col.indices
+                vals = np.asarray(col.data, dtype=np.float64)
+                zero_bin = int(m.value_to_bin(np.zeros(1))[0])
+                b_nz = m.value_to_bin(vals)
+                if multi:
+                    bb = _bundle_bin(m, b_nz, off)
+                    base = int(_bundle_bin(m, np.asarray([zero_bin]), off)[0])
+                    if base != 0:
+                        out[:, gid] = base
+                    nz = bb != base
+                    out[rows[nz], gid] = bb[nz].astype(dtype)
+                else:
+                    out[:, gid] = zero_bin
+                    out[rows, gid] = b_nz.astype(dtype)
+            else:
+                b = m.value_to_bin(Xv[:, real])
+                if multi:
+                    bb = _bundle_bin(m, b, off)
+                    nz = bb != 0
+                    out[nz, gid] = bb[nz].astype(dtype)
+                else:
+                    out[:, gid] = b.astype(dtype)
     return out
+
+
+def _is_sparse(X) -> bool:
+    return hasattr(X, "tocsc") and hasattr(X, "indptr") or \
+        type(X).__module__.startswith("scipy.sparse")

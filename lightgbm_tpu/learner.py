@@ -368,6 +368,8 @@ def build_tree_partitioned(
     hist_chunk: int = 2048,
     part_chunk: int = 2048,
     hist_exact: bool = True,
+    num_bin_hist: Optional[int] = None,   # bundled-column bins (defaults num_bin)
+    bundle: Optional[dict] = None,        # EFB maps (dataset.bundle_maps)
     constraint_sets: Optional[jax.Array] = None,   # (S, F) bool
     forced: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None,
 ) -> TreeLog:
@@ -389,21 +391,54 @@ def build_tree_partitioned(
     from .ops.histogram import hist16_segment
     from .ops.partition import pack_rows, partition_segment
 
-    n, num_feat = bins.shape
+    n, num_grp = bins.shape
+    num_feat = int(meta.num_bins.shape[0])
     max_splits = num_leaves - 1
     n_forced = 0 if forced is None else int(forced[0].shape[0])
     guard = max(part_chunk, hist_chunk)
+    bm = num_bin_hist if num_bin_hist is not None else num_bin
 
     # ---- packed ping-pong working buffers with guard rows ----
+    # the matrix columns are EFB bundles (== features when no bundling)
     pad = ((guard, guard), (0, 0))
     work0 = pack_rows(jnp.pad(bins, pad), jnp.pad(ghc, pad))
-    work = jnp.stack([work0, jnp.zeros_like(work0)])     # (2, Npad, F+12)
+    work = jnp.stack([work0, jnp.zeros_like(work0)])     # (2, Npad, G+12)
 
     def hist_of(work, plane, start, cnt):
-        h = hist16_segment(work, plane, start, cnt, num_bins=num_bin,
-                           num_feat=num_feat, exact=hist_exact,
+        h = hist16_segment(work, plane, start, cnt, num_bins=bm,
+                           num_feat=num_grp, exact=hist_exact,
                            chunk=hist_chunk)
-        return comm.psum(h)
+        return comm.psum(h)                               # (G, Bm, 3)
+
+    def feat_view(hg, total_sum):
+        """Bundled (G, Bm, 3) histogram -> per-feature (F, B, 3) view.
+
+        Each sub-feature's own bundle slots are gathered; its shared default
+        bin is recovered as total - sum(own slots) — the reference's
+        FixHistogram contract (include/LightGBM/dataset.h:503).
+        """
+        if bundle is None:
+            return hg
+        flat = hg.reshape(num_grp * bm, 3)
+        fh = jnp.take(flat, bundle["proj"].reshape(-1), axis=0) \
+            .reshape(num_feat, num_bin, 3)
+        fh = fh * bundle["valid"][:, :, None]
+        rest = total_sum[None, :] - jnp.sum(fh, axis=1)          # (F, 3)
+        dpos_oh = (jnp.arange(num_bin, dtype=jnp.int32)[None, :]
+                   == bundle["dpos"][:, None])                    # (F, B)
+        put = dpos_oh[:, :, None] & bundle["has_rest"][:, None, None]
+        return jnp.where(put, rest[:, None, :], fh)
+
+    def route_table(info):
+        """Feature-space (B,) routing table -> bundle-column (Bm,) table
+        (alien sub-features' slots and the shared zero follow the feature's
+        default-bin direction)."""
+        if bundle is None:
+            return info.go_left
+        row = bundle["map_fb"][info.feature]                      # (Bm,)
+        oh = row[:, None] == jnp.arange(num_bin, dtype=jnp.int32)[None, :]
+        return (oh.astype(jnp.float32)
+                @ info.go_left.astype(jnp.float32)) > 0.5
 
     best_for = _make_best_for(meta, hp, key, feature_mask, num_feat,
                               feature_fraction_bynode, extra_trees,
@@ -412,7 +447,7 @@ def build_tree_partitioned(
     # ---- init: root ----
     root_sum = comm.psum(jnp.sum(ghc, axis=0))
     root_hist = hist_of(work, jnp.int32(0), jnp.int32(guard), jnp.int32(n))
-    hist_pool = jnp.zeros((num_leaves, num_feat, num_bin, 3), jnp.float32)
+    hist_pool = jnp.zeros((num_leaves, num_grp, bm, 3), jnp.float32)
     hist_pool = hist_pool.at[0].set(root_hist)
     leaf_sum = jnp.zeros((num_leaves, 3), jnp.float32).at[0].set(root_sum)
     leaf_out = jnp.zeros((num_leaves,), jnp.float32).at[0].set(
@@ -425,9 +460,10 @@ def build_tree_partitioned(
     leaf_cnt = jnp.zeros((num_leaves,), jnp.int32).at[0].set(n)
     leaf_parity = jnp.zeros((num_leaves,), jnp.int32)
     best = _empty_best(num_leaves, num_bin)
-    best = _set_best(best, 0, best_for(0, jnp.int32(0), root_hist, root_sum,
-                                       leaf_out[0], leaf_lower[0],
-                                       leaf_upper[0], leaf_used[0]))
+    best = _set_best(best, 0,
+                     best_for(0, jnp.int32(0), feat_view(root_hist, root_sum),
+                              root_sum, leaf_out[0], leaf_lower[0],
+                              leaf_upper[0], leaf_used[0]))
     log = TreeLog(
         num_splits=jnp.int32(0),
         split_leaf=jnp.zeros((max_splits,), jnp.int32),
@@ -477,7 +513,7 @@ def build_tree_partitioned(
                 ri = jnp.minimum(r, n_forced - 1)
                 fl = f_leaf[ri]
                 fi = find_best_split(
-                    hist_pool[fl], leaf_sum[fl], meta,
+                    feat_view(hist_pool[fl], leaf_sum[fl]), leaf_sum[fl], meta,
                     jnp.arange(num_feat) == f_feat[ri], hp,
                     parent_output=leaf_out[fl], leaf_lower=leaf_lower[fl],
                     leaf_upper=leaf_upper[fl],
@@ -505,8 +541,10 @@ def build_tree_partitioned(
         start = leaf_start[leaf]
         cnt = leaf_cnt[leaf]
         parity = leaf_parity[leaf]
-        work, lt = partition_segment(work, parity, start, cnt, info.feature,
-                                     info.go_left, ch=part_chunk)
+        split_col = bundle["group"][info.feature] if bundle is not None \
+            else info.feature
+        work, lt = partition_segment(work, parity, start, cnt, split_col,
+                                     route_table(info), ch=part_chunk)
         new_parity = 1 - parity
 
         # ---- record ----
@@ -577,12 +615,12 @@ def build_tree_partitioned(
         leaf_used = leaf_used.at[leaf].set(sel(used_new, leaf_used[leaf])) \
             .at[new_leaf].set(sel(used_new, leaf_used[new_leaf]))
 
-        info_l = best_for(r, leaf, hist_left, info.left_sum,
-                          leaf_out[leaf], leaf_lower[leaf], leaf_upper[leaf],
-                          used_new)
-        info_r = best_for(r, new_leaf, hist_right, info.right_sum,
-                          leaf_out[new_leaf], leaf_lower[new_leaf],
-                          leaf_upper[new_leaf], used_new)
+        info_l = best_for(r, leaf, feat_view(hist_left, info.left_sum),
+                          info.left_sum, leaf_out[leaf], leaf_lower[leaf],
+                          leaf_upper[leaf], used_new)
+        info_r = best_for(r, new_leaf, feat_view(hist_right, info.right_sum),
+                          info.right_sum, leaf_out[new_leaf],
+                          leaf_lower[new_leaf], leaf_upper[new_leaf], used_new)
         gate_l = depth_ok(leaf_depth[leaf]) & valid
         gate_r = depth_ok(leaf_depth[new_leaf]) & valid
         info_l = info_l._replace(gain=jnp.where(gate_l, info_l.gain, -jnp.inf))
@@ -600,13 +638,16 @@ def build_tree_partitioned(
 
     carry = jax.lax.while_loop(cond, body, carry0)
     (_, _, _, _, _, _, leaf_sum, leaf_out, _, _, _, _, log, _, _) = carry
-    row_leaf = assign_leaves(bins, log, has_categorical=hp.has_categorical)
+    row_leaf = assign_leaves(bins, log, has_categorical=hp.has_categorical,
+                             bundle=bundle)
     return log._replace(leaf_value=leaf_out, leaf_sum=leaf_sum,
                         row_leaf=row_leaf)
 
 
+@partial(jax.jit, static_argnames=("has_categorical",))
 def assign_leaves(bins: jax.Array, log: TreeLog,
-                  has_categorical: bool = True) -> jax.Array:
+                  has_categorical: bool = True,
+                  bundle: Optional[dict] = None) -> jax.Array:
     """Route binned rows through a tree's split log (device analog of
     Tree::PredictLeafIndex over pre-binned data; used for valid-set score
     updates, mirroring ScoreUpdater's use of the data partition,
@@ -614,9 +655,12 @@ def assign_leaves(bins: jax.Array, log: TreeLog,
 
     Numerical splits route arithmetically (bin <= threshold, with the
     movable-missing bin overridden to the recorded default direction) —
-    no table gathers, which are slow on TPU. Categorical splits need the
-    full (B,) routing table; when the dataset has no categorical features
-    (static ``has_categorical=False``) that path is skipped entirely.
+    no table gathers, which are slow on TPU. With EFB bundles the matrix
+    columns are bundle-bin coded: the sub-feature's slots translate back
+    to feature bins arithmetically and all alien slots follow the shared
+    default bin's direction. Categorical splits need the full (B,) routing
+    table; when the dataset has no categorical features (static
+    ``has_categorical=False``) that path is skipped entirely.
     """
     n = bins.shape[0]
     max_splits = log.split_leaf.shape[0]
@@ -625,9 +669,25 @@ def assign_leaves(bins: jax.Array, log: TreeLog,
     def body(r, row_leaf):
         active = r < log.num_splits
         leaf = log.split_leaf[r]
-        col = jnp.take(bins, log.feature[r], axis=1).astype(jnp.int32)
+        fid = log.feature[r]
+        col_idx = bundle["group"][fid] if bundle is not None else fid
+        col = jnp.take(bins, col_idx, axis=1).astype(jnp.int32)
 
         def go_numerical(col):
+            if bundle is not None:
+                off = bundle["offset"][fid]
+                d = bundle["dpos"][fid]
+                rest_dir = log.go_left[r][d]
+                rank = col - off
+                fb = rank + (rank >= d)
+                in_range = bundle["has_rest"][fid] \
+                    & (col >= off) & (col < off + bundle["nbm1"][fid])
+                plain = ~bundle["has_rest"][fid]
+                eff = jnp.where(plain, col, fb)
+                go = eff <= log.bin[r]
+                go = jnp.where(log.movable[r] & (eff == log.miss_bin[r]),
+                               log.default_left[r], go)
+                return jnp.where(plain | in_range, go, rest_dir)
             go = col <= log.bin[r]
             return jnp.where(log.movable[r] & (col == log.miss_bin[r]),
                              log.default_left[r], go)
@@ -733,6 +793,12 @@ class SerialTreeLearner:
             has_monotone=dataset.monotone_constraints is not None,
         )
         self.bins = jnp.asarray(dataset.binned)
+        self.num_bin_hist = int(max(2, dataset.group_num_bins().max()
+                                    if dataset.num_groups else 2))
+        self.bundle = None
+        if dataset.has_bundles:
+            self.bundle = {k: jnp.asarray(v)
+                           for k, v in dataset.bundle_maps().items()}
         self.comm = Comm(comm_axis)
         self._build = jax.jit(self.make_build_fn())
 
@@ -741,13 +807,21 @@ class SerialTreeLearner:
         count exceeds the packed-u8 layout (max_bin > 256 -> u16 bins)."""
         mode = self.config.tree_builder
         if mode == "dense":
+            if self.bundle is not None:
+                Log.fatal("tree_builder=dense does not support EFB bundles; "
+                          "set enable_bundle=false or use the partitioned "
+                          "builder")
             return False
-        ok = self.num_bin <= 256 and self.bins.dtype == jnp.uint8
+        ok = self.num_bin <= 256 and self.num_bin_hist <= 256 \
+            and self.bins.dtype == jnp.uint8
         if mode == "partition" and not ok:
             Log.fatal(
                 "tree_builder=partition requires max_bin <= 256 (uint8 "
                 "bins); got %d bins. Use tree_builder=dense or lower "
                 "max_bin.", self.num_bin)
+        if not ok and self.bundle is not None:
+            Log.fatal("EFB bundles require the partitioned builder "
+                      "(max_bin <= 256)")
         return ok
 
     def make_build_fn(self):
@@ -775,6 +849,8 @@ class SerialTreeLearner:
                 hist_chunk=int(config.tpu_hist_chunk),
                 part_chunk=int(config.tpu_part_chunk),
                 hist_exact=config.tpu_hist_precision != "bf16",
+                num_bin_hist=self.num_bin_hist,
+                bundle=self.bundle,
             )
         else:
             kw.update(

@@ -2,141 +2,240 @@
 
 TPU-native replacement for the reference's per-row pointer walk
 (reference: include/LightGBM/tree.h:133 Tree::Predict,
-src/boosting/gbdt_prediction.cpp): the whole ensemble is packed into fixed
-(T, nodes) arrays, rows are routed by repeated gathers under ``lax.scan``
-over trees and ``lax.while_loop`` over depth — data-independent control
-flow, fully jittable, row-shardable over a mesh.
+src/boosting/gbdt_prediction.cpp, src/application/predictor.hpp:29).
 
-Routing happens in BIN space: raw features are binned once (value->bin is a
-per-feature searchsorted) and every split is a (B,) boolean table lookup.
-This makes numerical/categorical/missing handling uniform — the same trick
-the training partition uses.
+Design: every tree flattens into leaf-slot split order
+(Tree.to_split_arrays — the learner's TreeLog convention), and rows are
+routed ARITHMETICALLY: split r tests raw values against its threshold and
+moves non-left rows from slot[r] to slot r+1. No per-row pointer chasing,
+no table gathers (TPU element gathers are ~60ns/row); every step is a
+bandwidth-bound elementwise op over all rows, batched over trees with vmap.
+Missing handling mirrors tree.h NumericalDecision: NaN follows the default
+direction for MissingType::NaN, otherwise becomes 0; zeros follow the
+default direction for MissingType::Zero. Categorical splits test set
+membership against padded category tables.
+
+Routing works on RAW feature values, so it serves trained boosters and
+models loaded from reference-format text identically (no bin mappers
+needed).
 """
 from __future__ import annotations
 
+from functools import partial
 from typing import List, NamedTuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-
-class PackedTrees(NamedTuple):
-    """(T = trees, I = max internal nodes, B = max bins)"""
-    feature: jax.Array     # (T, I) i32 inner feature index
-    go_left: jax.Array     # (T, I, B) bool
-    left: jax.Array        # (T, I) i32 child (neg = ~leaf)
-    right: jax.Array       # (T, I) i32
-    leaf_value: jax.Array  # (T, L) f32
-    num_internal: jax.Array  # (T,) i32
-    tree_class: jax.Array  # (T,) i32 — class id of each tree (multiclass)
+K_ZERO = 1e-35
 
 
-def pack_trees(trees: List, dataset, num_bin: int, num_class: int = 1) -> PackedTrees:
-    """Build the packed arrays from host Tree models + the dataset's bin
-    mappers (bin tables absorb threshold/categorical/missing semantics)."""
-    from ..ops.binning import BIN_CATEGORICAL, MISSING_NAN, MISSING_ZERO
-    T = len(trees)
-    L = max((t.num_leaves for t in trees), default=1)
-    I = max(L - 1, 1)
-    feature = np.zeros((T, I), np.int32)
-    go_left = np.zeros((T, I, num_bin), bool)
-    left = np.full((T, I), -1, np.int32)
-    right = np.full((T, I), -1, np.int32)
-    leaf_value = np.zeros((T, L), np.float32)
-    num_internal = np.zeros(T, np.int32)
+class PackedSplits(NamedTuple):
+    """(T trees, R max splits, L max leaves, Kc max categories)"""
+    slot: jax.Array          # (T, R) i32
+    feature: jax.Array       # (T, R) i32 column index into X
+    threshold: jax.Array     # (T, R) f32
+    kind: jax.Array          # (T, R) i32  0 numerical / 1 categorical
+    default_left: jax.Array  # (T, R) bool
+    missing_type: jax.Array  # (T, R) i32
+    num_splits: jax.Array    # (T,) i32
+    value_of_slot: jax.Array  # (T, L) f32 leaf outputs by slot
+    tree_class: jax.Array    # (T,) i32
+    cat_values: jax.Array    # (T, R, Kc) i32, padded with -2 (never matches)
+
+
+def pack_splits(trees: List, num_class: int = 1) -> PackedSplits:
+    """Pack host Tree models into device arrays (raw-value routing)."""
+    T = max(len(trees), 1)
+    arrs = [t.to_split_arrays() for t in trees] or \
+        [dict(slot=np.zeros(0, np.int32), feature=np.zeros(0, np.int32),
+              threshold=np.zeros(0), kind=np.zeros(0, np.int32),
+              default_left=np.zeros(0, bool), missing_type=np.zeros(0, np.int32),
+              cat_values={}, leaf_of_slot=np.zeros(1, np.int32))]
+    R = max((len(a["slot"]) for a in arrs), default=0)
+    R = max(R, 1)
+    L = R + 1
+    Kc = max((len(v) for a in arrs for v in a["cat_values"].values()),
+             default=0)
+    has_cat = Kc > 0
+    Kc = max(Kc, 1)
+
+    slot = np.zeros((T, R), np.int32)
+    feature = np.zeros((T, R), np.int32)
+    threshold = np.zeros((T, R), np.float32)
+    kind = np.zeros((T, R), np.int32)
+    default_left = np.zeros((T, R), bool)
+    missing_type = np.zeros((T, R), np.int32)
+    num_splits = np.zeros(T, np.int32)
+    value_of_slot = np.zeros((T, L), np.float32)
     tree_class = np.zeros(T, np.int32)
-    b_iota = np.arange(num_bin)
-    for ti, t in enumerate(trees):
+    cat_values = np.full((T, R, Kc), -2, np.int64)
+    for ti, (t, a) in enumerate(zip(trees, arrs)):
+        r = len(a["slot"])
+        num_splits[ti] = r
         tree_class[ti] = ti % num_class
-        leaf_value[ti, : t.num_leaves] = t.leaf_value
-        num_internal[ti] = t.num_internal if t.num_leaves > 1 else 0
-        if t.num_leaves <= 1:
-            continue
-        for nd in range(t.num_internal):
-            real_f = int(t.split_feature[nd])
-            inner = dataset.inner_feature_index(real_f)
-            if inner < 0:
-                inner = 0
-                tbl = np.zeros(num_bin, bool)
-            else:
-                mapper = dataset.bin_mappers[inner]
-                if t.decision_type[nd] & 1:
-                    cats = t.cat_threshold.get(nd, np.array([], dtype=np.int64))
-                    cat_of_bin = np.full(num_bin, -1, np.int64)
-                    nc = len(mapper.categories)
-                    cat_of_bin[:nc] = mapper.categories
-                    tbl = np.isin(cat_of_bin, cats)
-                else:
-                    # threshold value -> bin: route by real threshold so models
-                    # loaded from text (value thresholds) stay exact
-                    thr = float(t.threshold[nd])
-                    ub = mapper.upper_bounds
-                    tbin = int(np.searchsorted(ub, thr, side="left"))
-                    tbin = min(tbin, mapper.num_bins - 1)
-                    tbl = b_iota <= tbin
-                    if mapper.missing_type in (MISSING_NAN, MISSING_ZERO) \
-                            and mapper.bin_type != BIN_CATEGORICAL:
-                        tbl = tbl.copy()
-                        tbl[mapper.missing_bin] = bool(t.decision_type[nd] & 2)
-            feature[ti, nd] = inner
-            go_left[ti, nd] = tbl
-            left[ti, nd] = t.left_child[nd]
-            right[ti, nd] = t.right_child[nd]
-    return PackedTrees(
-        feature=jnp.asarray(feature), go_left=jnp.asarray(go_left),
-        left=jnp.asarray(left), right=jnp.asarray(right),
-        leaf_value=jnp.asarray(leaf_value), num_internal=jnp.asarray(num_internal),
-        tree_class=jnp.asarray(tree_class))
+        slot[ti, :r] = a["slot"]
+        feature[ti, :r] = a["feature"]
+        threshold[ti, :r] = a["threshold"]
+        kind[ti, :r] = a["kind"]
+        default_left[ti, :r] = a["default_left"]
+        missing_type[ti, :r] = a["missing_type"]
+        lv = t.leaf_value[a["leaf_of_slot"][:r + 1]] if t.num_leaves > 1 \
+            else t.leaf_value[:1]
+        value_of_slot[ti, :len(lv)] = lv
+        for rr, cats in a["cat_values"].items():
+            cat_values[ti, rr, :len(cats)] = cats
+    pk = PackedSplits(
+        slot=jnp.asarray(slot), feature=jnp.asarray(feature),
+        threshold=jnp.asarray(threshold), kind=jnp.asarray(kind),
+        default_left=jnp.asarray(default_left),
+        missing_type=jnp.asarray(missing_type),
+        num_splits=jnp.asarray(num_splits),
+        value_of_slot=jnp.asarray(value_of_slot),
+        tree_class=jnp.asarray(tree_class),
+        cat_values=jnp.asarray(cat_values, jnp.int32))
+    return pk, has_cat
 
 
-def predict_binned(bins: jax.Array, pack: PackedTrees, num_class: int = 1,
-                   init_score: jax.Array = None) -> jax.Array:
-    """(N, F) binned rows -> (N,) or (N, K) raw scores."""
-    n = bins.shape[0]
-    num_trees = pack.feature.shape[0]
+def _route_tree(X, tp, has_cat: bool):
+    """Route all rows through one packed tree -> (N,) leaf slots."""
+    n = X.shape[0]
+    max_r = tp.slot.shape[0]
 
-    def one_tree(carry, tp):
-        score = carry
-        feat, tbl, lc, rc, lv, ni, cls = tp
+    def step(r, row_slot):
+        active = r < tp.num_splits
+        col = jnp.take(X, tp.feature[r], axis=1)
+        mt = tp.missing_type[r]
+        nan = jnp.isnan(col)
+        v = jnp.where(nan & (mt != 2), 0.0, col)
+        go = v <= tp.threshold[r]
+        go = jnp.where((mt == 2) & nan, tp.default_left[r], go)
+        go = jnp.where((mt == 1) & (jnp.abs(v) <= K_ZERO),
+                       tp.default_left[r], go)
+        if has_cat:
+            iv = jnp.where(jnp.isfinite(col), col, -1.0).astype(jnp.int32)
+            in_set = jnp.any(iv[:, None] == tp.cat_values[r][None, :], axis=1)
+            go = jnp.where(tp.kind[r] > 0, in_set, go)
+        upd = jnp.where((row_slot == tp.slot[r]) & ~go, r + 1, row_slot)
+        return jnp.where(active, upd, row_slot)
 
-        def routing_step(state):
-            node, _ = state
-            f = feat[jnp.maximum(node, 0)]
-            b = jnp.take_along_axis(bins, f[:, None].astype(jnp.int32),
-                                    axis=1)[:, 0].astype(jnp.int32)
-            gl = tbl[jnp.maximum(node, 0), b]
-            nxt = jnp.where(gl, lc[jnp.maximum(node, 0)], rc[jnp.maximum(node, 0)])
-            node = jnp.where(node >= 0, nxt, node)
-            return node, jnp.any(node >= 0)
+    return jax.lax.fori_loop(0, max_r, step, jnp.zeros((n,), jnp.int32))
 
-        node0 = jnp.where(ni > 0, 0, -1) * jnp.ones((n,), jnp.int32)
-        node, _ = jax.lax.while_loop(lambda s: s[1], routing_step,
-                                     (node0, ni > 0))
-        leaf = jnp.where(node < 0, ~node, 0)
-        vals = lv[leaf]
+
+@partial(jax.jit, static_argnames=("num_class", "has_cat", "tree_batch"))
+def predict_raw(X: jax.Array, pack: PackedSplits, *, num_class: int = 1,
+                has_cat: bool = False, tree_batch: int = 8,
+                init_score=None) -> jax.Array:
+    """(N, F) raw rows -> (N,) or (N, K) raw ensemble scores."""
+    from ..learner import leaf_values_by_row
+
+    n = X.shape[0]
+    X = X.astype(jnp.float32)
+    T = pack.slot.shape[0]
+    pad_t = (-T) % tree_batch
+    if pad_t:
+        pack = jax.tree.map(
+            lambda a: jnp.concatenate(
+                [a, jnp.zeros((pad_t,) + a.shape[1:], a.dtype)]), pack)
+    num_l = pack.value_of_slot.shape[1]
+    grouped = jax.tree.map(
+        lambda a: a.reshape(-1, tree_batch, *a.shape[1:]), pack)
+
+    def one_batch(score, tb):
+        slots = jax.vmap(lambda tp: _route_tree(X, tp, has_cat))(tb)  # (tb, N)
+        vals = jax.vmap(lambda lv, s: leaf_values_by_row(lv, s, num_l))(
+            tb.value_of_slot, slots)                                  # (tb, N)
+        # unsplit and padding trees both carry all-zero slot values
         if num_class > 1:
-            score = score.at[:, cls].add(vals)
+            cls_oh = (tb.tree_class[:, None]
+                      == jnp.arange(num_class)[None, :]).astype(jnp.float32)
+            score = score + vals.T @ cls_oh
         else:
-            score = score + vals
+            score = score + jnp.sum(vals, axis=0)
         return score, None
 
     shape = (n, num_class) if num_class > 1 else (n,)
     score0 = jnp.zeros(shape, jnp.float32)
     if init_score is not None:
         score0 = score0 + init_score
-    score, _ = jax.lax.scan(one_tree, score0, pack)
+    score, _ = jax.lax.scan(one_batch, score0, grouped)
     return score
 
 
-def bin_values_device(X: jax.Array, upper_bounds: jax.Array,
-                      nan_bins: jax.Array, nan_missing: jax.Array) -> jax.Array:
-    """Vectorized value->bin on device for numerical features:
-    (N, F) raw + (F, Bmax) padded upper bounds -> (N, F) bins.
-    (Categorical features are binned on host — dictionary lookup.)"""
-    # searchsorted per feature via comparison count: bin = sum(ub < x)
-    nan_mask = jnp.isnan(X)
-    Xz = jnp.where(nan_mask & ~nan_missing[None, :], 0.0, X)
-    bins = jnp.sum(Xz[:, :, None] > upper_bounds.T[None, :, :], axis=2)
-    bins = jnp.where(nan_mask & nan_missing[None, :], nan_bins[None, :], bins)
-    return bins.astype(jnp.int32)
+def tree_to_bin_log(tree, dataset):
+    """Convert a host Tree into a TreeLog-compatible record routing in BIN
+    space over the dataset's (bundled) training matrix — lets DART score
+    replay, rollback and continued-training valid replay reuse
+    ``assign_leaves`` on device instead of walking trees in Python
+    (reference analogs: dart.hpp score updates, gbdt.cpp:454
+    RollbackOneIter)."""
+    from ..learner import TreeLog
+    from .binning import BIN_CATEGORICAL, MISSING_NAN, MISSING_ZERO
+
+    a = tree.to_split_arrays()
+    r = len(a["slot"])
+    num_bin = int(dataset.feature_num_bins().max()) if dataset.num_features \
+        else 1
+    # pad split count to a power-of-two bucket so assign_leaves compiles a
+    # handful of signatures instead of one per distinct tree size
+    rp = 16
+    while rp < r:
+        rp *= 2
+    feature = np.zeros(rp, np.int32)
+    tbin = np.zeros(rp, np.int32)
+    kind = np.zeros(rp, np.int32)
+    miss_bin = np.zeros(rp, np.int32)
+    movable = np.zeros(rp, bool)
+    go_left = np.zeros((rp, num_bin), bool)
+    b_iota = np.arange(num_bin)
+    for i in range(r):
+        inner = dataset.inner_feature_index(int(a["feature"][i]))
+        if inner < 0:
+            continue
+        m = dataset.bin_mappers[inner]
+        feature[i] = inner
+        if a["kind"][i]:
+            kind[i] = 1
+            cats = a["cat_values"].get(i, np.array([], np.int64))
+            cat_of_bin = np.full(num_bin, -1, np.int64)
+            nc = len(m.categories)
+            cat_of_bin[:nc] = m.categories
+            go_left[i] = np.isin(cat_of_bin, cats)
+        else:
+            tb = int(np.searchsorted(m.upper_bounds, float(a["threshold"][i]),
+                                     side="left"))
+            tb = min(tb, m.num_bins - 1)
+            tbin[i] = tb
+            tbl = b_iota <= tb
+            if m.missing_type in (MISSING_ZERO, MISSING_NAN) \
+                    and m.bin_type != BIN_CATEGORICAL:
+                tbl = tbl.copy()
+                tbl[m.missing_bin] = bool(a["default_left"][i])
+                miss_bin[i] = m.missing_bin
+                movable[i] = True
+            go_left[i] = tbl
+    slot = np.zeros(rp, np.int32)
+    slot[:r] = a["slot"]
+    default_left = np.zeros(rp, bool)
+    default_left[:r] = a["default_left"]
+    leaf_value = np.zeros(rp + 1, np.float32)
+    leaf_value[:r + 1] = tree.leaf_value[a["leaf_of_slot"][:r + 1]] \
+        if r else tree.leaf_value[:1]
+    return TreeLog(
+        num_splits=jnp.int32(r),
+        split_leaf=jnp.asarray(slot),
+        feature=jnp.asarray(feature),
+        bin=jnp.asarray(tbin),
+        kind=jnp.asarray(kind),
+        default_left=jnp.asarray(default_left),
+        gain=jnp.zeros(rp, jnp.float32),
+        left_sum=jnp.zeros((rp, 3), jnp.float32),
+        right_sum=jnp.zeros((rp, 3), jnp.float32),
+        go_left=jnp.asarray(go_left),
+        miss_bin=jnp.asarray(miss_bin),
+        movable=jnp.asarray(movable),
+        leaf_value=jnp.asarray(leaf_value),
+        leaf_sum=jnp.zeros((rp + 1, 3), jnp.float32),
+        row_leaf=jnp.zeros(1, jnp.int32),
+    )

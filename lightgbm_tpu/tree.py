@@ -195,6 +195,65 @@ class Tree:
             active = node >= 0
         return (~node).astype(np.int32)
 
+    def to_split_arrays(self) -> Dict[str, np.ndarray]:
+        """Flatten the node structure into leaf-slot split order.
+
+        Returns per-split arrays usable by the device routers: processing
+        split r, rows on slot ``slot[r]`` move to slot ``r+1`` unless the
+        decision sends them left (the same slot-reuse convention as the
+        learner's TreeLog; from_split_log's inverse). Works for any tree —
+        including models loaded from reference-format text.
+        """
+        R = self.num_internal if self.num_leaves > 1 else 0
+        slot = np.zeros(R, np.int32)
+        feature = np.zeros(R, np.int32)
+        threshold = np.zeros(R, np.float64)
+        kind = np.zeros(R, np.int32)          # 0 numerical, 1 categorical
+        default_left = np.zeros(R, bool)
+        missing_type = np.zeros(R, np.int32)
+        cat_values: Dict[int, np.ndarray] = {}
+        leaf_of_slot = np.zeros(max(self.num_leaves, 1), np.int32)
+        if R == 0:
+            leaf_of_slot[0] = 0
+            return dict(slot=slot, feature=feature, threshold=threshold,
+                        kind=kind, default_left=default_left,
+                        missing_type=missing_type, cat_values=cat_values,
+                        leaf_of_slot=leaf_of_slot)
+        # BFS from the root; order = our split order r; slots assigned on
+        # the fly (left keeps the parent's slot, right takes slot r+1)
+        order: List[int] = []
+        node_slot = {0: 0}
+        queue = [0]
+        while queue:
+            nd = queue.pop(0)
+            r = len(order)
+            order.append(nd)
+            s = node_slot.pop(nd)
+            slot[r] = s
+            feature[r] = self.split_feature[nd]
+            threshold[r] = self.threshold[nd]
+            dt = int(self.decision_type[nd])
+            kind[r] = 1 if dt & K_CATEGORICAL_MASK else 0
+            default_left[r] = bool(dt & K_DEFAULT_LEFT_MASK)
+            missing_type[r] = (dt >> 2) & 3
+            if kind[r]:
+                cat_values[r] = self.cat_threshold.get(
+                    nd, np.array([], dtype=np.int64))
+            for child, child_slot in ((self.left_child[nd], s),
+                                      (self.right_child[nd], r + 1)):
+                if child >= 0:
+                    node_slot[int(child)] = child_slot
+                    queue.append(int(child))
+                else:
+                    leaf_of_slot[child_slot] = ~child
+        # BFS guarantees parents precede children, but the right-child slot
+        # r+1 refers to THIS split's position — valid since rows can only
+        # reach a child's test after the parent's test ran
+        return dict(slot=slot, feature=feature, threshold=threshold,
+                    kind=kind, default_left=default_left,
+                    missing_type=missing_type, cat_values=cat_values,
+                    leaf_of_slot=leaf_of_slot)
+
     def apply_shrinkage(self, rate: float) -> None:
         """(reference: tree.h:187 Shrinkage)"""
         self.leaf_value *= rate

@@ -4,13 +4,18 @@ The reference tests multi-node behavior with in-process Dask workers
 (reference: tests/python_package_test/test_dask.py:26). Here the analog is
 8 virtual CPU devices via XLA host-platform device count; distributed tests
 build a jax.sharding.Mesh over them.
+
+Caveat: the axon sitecustomize registers its TPU backend at interpreter
+start (before conftest runs), so on an axon-attached terminal the env
+settings below do NOT take effect and the suite runs on the real device;
+tests that genuinely need the 8-device mesh use the ``cpu_mesh_devices``
+fixture (skipped on non-mesh backends) and are additionally driven through
+a clean-environment subprocess by tests/test_parallel.py's launcher.
 """
 import os
+import sys
 
-# Hard-force the CPU host platform: the axon sitecustomize registers the TPU
-# backend regardless of JAX_PLATFORMS unless its trigger env var is absent.
-os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = _flags + " --xla_force_host_platform_device_count=8"
@@ -25,3 +30,30 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return np.random.RandomState(42)
+
+
+@pytest.fixture(scope="session")
+def cpu_mesh_devices():
+    """The 8 virtual CPU devices; skips when the env forcing could not take
+    effect (axon terminals — see module docstring)."""
+    import jax
+
+    devs = jax.devices()
+    if jax.default_backend() != "cpu" or len(devs) < 8:
+        pytest.skip("needs the 8-device CPU mesh (JAX_PLATFORMS=cpu + "
+                    "xla_force_host_platform_device_count=8)")
+    return devs
+
+
+def clean_cpu_env(n_devices: int = 8) -> dict:
+    """Environment for subprocesses that must run on the virtual CPU mesh
+    even under an axon terminal (whose sitecustomize grabs the backend at
+    interpreter start)."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + f" --xla_force_host_platform_device_count={n_devices}")
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    return env
