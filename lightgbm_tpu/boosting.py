@@ -334,14 +334,14 @@ class GBDT:
         """True when K iterations can run as one device launch (no per-iter
         host observation needed): plain GBDT, built-in objective without
         leaf renewal, no valid sets, single-device learner."""
-        from .parallel.mesh import DataParallelTreeLearner
+        from .parallel.mesh import _MeshTreeLearner
         return (type(self) is GBDT
                 and self.objective is not None
                 and self.objective.name != "none"
                 and not self.objective.need_renew
                 and not self.valid_sets
                 and self.train_set is not None
-                and not isinstance(self.learner, DataParallelTreeLearner))
+                and not isinstance(self.learner, _MeshTreeLearner))
 
     def train_block(self, k: int) -> bool:
         """Train k iterations fused in one launch (see fused.py)."""

@@ -46,16 +46,86 @@ from .utils.log import Log
 
 class Comm:
     """Collective seam (reference analog: static class Network,
-    include/LightGBM/network.h:89). ``axis=None`` = single device no-op;
-    otherwise psum over the named mesh axis inside shard_map."""
+    include/LightGBM/network.h:89, and the per-strategy hooks of the
+    Data/Feature/Voting-parallel tree learners). ``axis=None`` = single
+    device no-op; otherwise collectives run over the named mesh axis
+    inside shard_map.
 
-    def __init__(self, axis: Optional[str] = None) -> None:
+    Modes (reference: src/treelearner/tree_learner.cpp:15 factory):
+    - ``serial``/``data``: rows sharded; histograms are globally reduced
+      (data_parallel_tree_learner.cpp:169) and every shard computes the
+      same best split — no split sync needed.
+    - ``feature``: rows REPLICATED, the split SEARCH is sharded by feature
+      ownership; the winning SplitInfo is argmax-synced across shards
+      (feature_parallel_tree_learner.cpp:40, parallel_tree_learner.h:191
+      SyncUpGlobalBestSplit).
+    - ``voting``: rows sharded, histograms stay LOCAL; shards vote local
+      top-k features, the global top-2k features' histograms are merged,
+      and the best split comes from the merged histograms — comm volume is
+      O(top_k * B) per round instead of O(F * B)
+      (voting_parallel_tree_learner.cpp:151 GlobalVoting).
+    """
+
+    def __init__(self, axis: Optional[str] = None, mode: Optional[str] = None,
+                 top_k: int = 20, num_machines: int = 1) -> None:
         self.axis = axis
+        self.mode = mode or ("data" if axis else "serial")
+        self.top_k = int(top_k)
+        self.num_machines = int(num_machines)
 
     def psum(self, x):
         if self.axis is None:
             return x
         return jax.lax.psum(x, self.axis)
+
+    def hist(self, h):
+        """Leaf-histogram reduction: full psum for data-parallel; identity
+        when rows are replicated (feature) or hists stay local (voting)."""
+        if self.axis is None or self.mode in ("feature", "voting"):
+            return h
+        return jax.lax.psum(h, self.axis)
+
+    def root(self, x):
+        """Root gradient-sum reduction (replicated rows: identity)."""
+        if self.axis is None or self.mode == "feature":
+            return x
+        return jax.lax.psum(x, self.axis)
+
+    def owned_mask(self, num_feat: int):
+        """Feature-parallel search ownership (reference balances by bin
+        count, feature_parallel_tree_learner.cpp:40; modulo striping gives
+        the same asymptotic balance)."""
+        if self.mode != "feature" or self.axis is None:
+            return None
+        idx = jax.lax.axis_index(self.axis)
+        return (jnp.arange(num_feat, dtype=jnp.int32)
+                % self.num_machines) == idx
+
+    def sync_split(self, info):
+        """Broadcast the globally-best SplitInfo (SyncUpGlobalBestSplit,
+        parallel_tree_learner.h:191): allgather gains, argmax (ties to the
+        lowest shard), then a masked psum carries every field over."""
+        if self.mode != "feature" or self.axis is None:
+            return info
+        idx = jax.lax.axis_index(self.axis)
+        gains = jax.lax.all_gather(info.gain, self.axis)          # (D,)
+        win = jnp.argmax(jnp.where(jnp.isnan(gains), -jnp.inf, gains))
+        mine = (idx == win).astype(jnp.float32)
+
+        def bcast(x):
+            guarded = jnp.where(jnp.isfinite(x.astype(jnp.float32)),
+                                x.astype(jnp.float32), 0.0) \
+                if x.dtype == jnp.float32 else x.astype(jnp.float32)
+            out = jax.lax.psum(guarded * mine, self.axis)
+            if x.dtype == jnp.float32:
+                # restore -inf gains the masking zeroed out
+                neg = jax.lax.psum(
+                    jnp.isneginf(x.astype(jnp.float32)).astype(jnp.float32)
+                    * mine, self.axis) > 0.5
+                out = jnp.where(neg, -jnp.inf, out)
+            return out.astype(x.dtype)
+
+        return jax.tree.map(bcast, info)
 
 
 class TreeLog(NamedTuple):
@@ -131,13 +201,17 @@ def _make_best_for(meta: FeatureMeta, hp: SplitHyper, key, feature_mask,
                 .astype(jnp.int32)
         return fmask, rand_thr
 
-    def best_for(r, leaf, hist, parent_sum, parent_out, lower, upper, used_row):
+    def best_for(r, leaf, hist, parent_sum, parent_out, lower, upper,
+                 used_row, extra_mask=None, want_feature_gains=False,
+                 use_hp=None):
         fmask, rand_thr = node_inputs(r, leaf)
         fmask = fmask & allowed_mask(used_row)
+        if extra_mask is not None:
+            fmask = fmask & extra_mask
         return find_best_split(
-            hist, parent_sum, meta, fmask, hp,
+            hist, parent_sum, meta, fmask, use_hp if use_hp is not None else hp,
             parent_output=parent_out, leaf_lower=lower, leaf_upper=upper,
-            rand_threshold=rand_thr)
+            rand_threshold=rand_thr, want_feature_gains=want_feature_gains)
 
     return best_for
 
@@ -408,7 +482,7 @@ def build_tree_partitioned(
         h = hist16_segment(work, plane, start, cnt, num_bins=bm,
                            num_feat=num_grp, exact=hist_exact,
                            chunk=hist_chunk)
-        return comm.psum(h)                               # (G, Bm, 3)
+        return comm.hist(h)                               # (G, Bm, 3)
 
     def feat_view(hg, total_sum):
         """Bundled (G, Bm, 3) histogram -> per-feature (F, B, 3) view.
@@ -440,16 +514,63 @@ def build_tree_partitioned(
         return (oh.astype(jnp.float32)
                 @ info.go_left.astype(jnp.float32)) > 0.5
 
-    best_for = _make_best_for(meta, hp, key, feature_mask, num_feat,
+    fmask_search = feature_mask
+    owned = comm.owned_mask(num_feat)
+    if owned is not None:
+        fmask_search = feature_mask & owned
+    best_raw = _make_best_for(meta, hp, key, fmask_search, num_feat,
                               feature_fraction_bynode, extra_trees,
                               constraint_sets)
+    voting = comm.mode == "voting"
+    if voting:
+        d = float(max(comm.num_machines, 1))
+        # local vote constraints are scaled by 1/num_machines
+        # (reference: voting_parallel_tree_learner.cpp:62-64)
+        hp_loc = hp._replace(
+            min_data_in_leaf=hp.min_data_in_leaf / d,
+            min_sum_hessian_in_leaf=hp.min_sum_hessian_in_leaf / d)
+
+    def node_best(r, leaf, hg, tot_g, tot_l, parent_out, lower, upper,
+                  used_row):
+        """Best split for a node under the active comm strategy. ``hg`` is
+        the (bundled) histogram — global for serial/data/feature, LOCAL for
+        voting; ``tot_g``/``tot_l`` the node's global/local (g,h,cnt)."""
+        if not voting:
+            info = best_raw(r, leaf, feat_view(hg, tot_g), tot_g, parent_out,
+                            lower, upper, used_row)
+            return comm.sync_split(info)
+        # ---- voting parallel (reference: GlobalVoting,
+        # voting_parallel_tree_learner.cpp:151,322) ----
+        fv_loc = feat_view(hg, tot_l)
+        fg = best_raw(r, leaf, fv_loc, tot_l, parent_out, lower, upper,
+                      used_row, want_feature_gains=True, use_hp=hp_loc)
+        k = min(comm.top_k, num_feat)
+        k2 = min(2 * comm.top_k, num_feat)
+        _, top_idx = jax.lax.top_k(fg, k)
+        votes = jnp.zeros((num_feat,), jnp.float32).at[top_idx].add(1.0)
+        votes = comm.psum(votes)
+        # deterministic global top-2k (ties resolve to the lowest index)
+        bias = -jnp.arange(num_feat, dtype=jnp.float32) * 1e-6
+        _, sel = jax.lax.top_k(votes + bias, k2)
+        selmat = (sel[:, None]
+                  == jnp.arange(num_feat, dtype=jnp.int32)[None, :]) \
+            .astype(jnp.float32)                               # (k2, F)
+        flat = fv_loc.reshape(num_feat, -1)
+        merged = comm.psum(selmat @ flat)                      # (k2, B*3)
+        full = (selmat.T @ merged).reshape(fv_loc.shape)       # voted rows only
+        selmask = jnp.any(selmat > 0.5, axis=0)
+        return best_raw(r, leaf, full, tot_g, parent_out, lower, upper,
+                        used_row, extra_mask=selmask)
 
     # ---- init: root ----
-    root_sum = comm.psum(jnp.sum(ghc, axis=0))
+    root_sum_loc = jnp.sum(ghc, axis=0)
+    root_sum = comm.root(root_sum_loc)
     root_hist = hist_of(work, jnp.int32(0), jnp.int32(guard), jnp.int32(n))
     hist_pool = jnp.zeros((num_leaves, num_grp, bm, 3), jnp.float32)
     hist_pool = hist_pool.at[0].set(root_hist)
     leaf_sum = jnp.zeros((num_leaves, 3), jnp.float32).at[0].set(root_sum)
+    leaf_sum_loc = jnp.zeros((num_leaves, 3), jnp.float32).at[0].set(
+        root_sum_loc)
     leaf_out = jnp.zeros((num_leaves,), jnp.float32).at[0].set(
         calc_leaf_output(root_sum[0], root_sum[1], hp))
     leaf_depth = jnp.zeros((num_leaves,), jnp.int32)
@@ -461,9 +582,9 @@ def build_tree_partitioned(
     leaf_parity = jnp.zeros((num_leaves,), jnp.int32)
     best = _empty_best(num_leaves, num_bin)
     best = _set_best(best, 0,
-                     best_for(0, jnp.int32(0), feat_view(root_hist, root_sum),
-                              root_sum, leaf_out[0], leaf_lower[0],
-                              leaf_upper[0], leaf_used[0]))
+                     node_best(0, jnp.int32(0), root_hist, root_sum,
+                               root_sum_loc, leaf_out[0], leaf_lower[0],
+                               leaf_upper[0], leaf_used[0]))
     log = TreeLog(
         num_splits=jnp.int32(0),
         split_leaf=jnp.zeros((max_splits,), jnp.int32),
@@ -489,19 +610,19 @@ def build_tree_partitioned(
 
     force_live = jnp.bool_(n_forced > 0)
     carry0 = (jnp.int32(0), work, leaf_start, leaf_cnt, leaf_parity,
-              hist_pool, leaf_sum, leaf_out, leaf_depth, leaf_lower,
-              leaf_upper, best, log, leaf_used, force_live)
+              hist_pool, leaf_sum, leaf_sum_loc, leaf_out, leaf_depth,
+              leaf_lower, leaf_upper, best, log, leaf_used, force_live)
 
     def cond(carry):
-        r, best, log, force_live = carry[0], carry[11], carry[12], carry[14]
+        r, best, log, force_live = carry[0], carry[12], carry[13], carry[15]
         forcing = force_live & (r < n_forced) if n_forced else False
         return (log.num_splits < max_splits) & (r < max_splits + n_forced) \
             & ((jnp.max(best.gain) > 0.0) | forcing)
 
     def body(carry):
         (r, work, leaf_start, leaf_cnt, leaf_parity, hist_pool, leaf_sum,
-         leaf_out, leaf_depth, leaf_lower, leaf_upper, best, log, leaf_used,
-         force_live) = carry
+         leaf_sum_loc, leaf_out, leaf_depth, leaf_lower, leaf_upper, best,
+         log, leaf_used, force_live) = carry
         leaf = jnp.argmax(best.gain).astype(jnp.int32)
         info: SplitInfo = jax.tree.map(lambda a: a[leaf], best)
         if n_forced:
@@ -513,7 +634,9 @@ def build_tree_partitioned(
                 ri = jnp.minimum(r, n_forced - 1)
                 fl = f_leaf[ri]
                 fi = find_best_split(
-                    feat_view(hist_pool[fl], leaf_sum[fl]), leaf_sum[fl], meta,
+                    feat_view(hist_pool[fl],
+                              leaf_sum_loc[fl] if voting else leaf_sum[fl]),
+                    leaf_sum[fl], meta,
                     jnp.arange(num_feat) == f_feat[ri], hp,
                     parent_output=leaf_out[fl], leaf_lower=leaf_lower[fl],
                     leaf_upper=leaf_upper[fl],
@@ -609,18 +732,25 @@ def build_tree_partitioned(
         hist_right = jnp.where(left_smaller, hist_large, hist_small)
         hist_pool = hist_pool.at[leaf].set(sel(hist_left, parent_hist)) \
             .at[new_leaf].set(sel(hist_right, hist_pool[new_leaf]))
+        # local (g,h,cnt) totals per child (voting mode votes with these;
+        # any group's bins partition the rows, so group 0 sums the leaf)
+        loc_parent = leaf_sum_loc[leaf]
+        loc_left = jnp.sum(hist_left[0], axis=0)
+        loc_right = loc_parent - loc_left
+        leaf_sum_loc = leaf_sum_loc.at[leaf].set(sel(loc_left, loc_parent)) \
+            .at[new_leaf].set(sel(loc_right, leaf_sum_loc[new_leaf]))
 
         # ---- refresh best splits for the two children ----
         used_new = leaf_used[leaf].at[info.feature].set(True)
         leaf_used = leaf_used.at[leaf].set(sel(used_new, leaf_used[leaf])) \
             .at[new_leaf].set(sel(used_new, leaf_used[new_leaf]))
 
-        info_l = best_for(r, leaf, feat_view(hist_left, info.left_sum),
-                          info.left_sum, leaf_out[leaf], leaf_lower[leaf],
-                          leaf_upper[leaf], used_new)
-        info_r = best_for(r, new_leaf, feat_view(hist_right, info.right_sum),
-                          info.right_sum, leaf_out[new_leaf],
-                          leaf_lower[new_leaf], leaf_upper[new_leaf], used_new)
+        info_l = node_best(r, leaf, hist_left, info.left_sum, loc_left,
+                           leaf_out[leaf], leaf_lower[leaf],
+                           leaf_upper[leaf], used_new)
+        info_r = node_best(r, new_leaf, hist_right, info.right_sum, loc_right,
+                           leaf_out[new_leaf], leaf_lower[new_leaf],
+                           leaf_upper[new_leaf], used_new)
         gate_l = depth_ok(leaf_depth[leaf]) & valid
         gate_r = depth_ok(leaf_depth[new_leaf]) & valid
         info_l = info_l._replace(gain=jnp.where(gate_l, info_l.gain, -jnp.inf))
@@ -633,11 +763,11 @@ def build_tree_partitioned(
                          jax.tree.map(sel, info_r, old_r))
 
         return (r + 1, work, leaf_start, leaf_cnt, leaf_parity, hist_pool,
-                leaf_sum, leaf_out, leaf_depth, leaf_lower, leaf_upper, best,
-                log, leaf_used, force_live)
+                leaf_sum, leaf_sum_loc, leaf_out, leaf_depth, leaf_lower,
+                leaf_upper, best, log, leaf_used, force_live)
 
     carry = jax.lax.while_loop(cond, body, carry0)
-    (_, _, _, _, _, _, leaf_sum, leaf_out, _, _, _, _, log, _, _) = carry
+    (_, _, _, _, _, _, leaf_sum, _, leaf_out, _, _, _, _, log, _, _) = carry
     row_leaf = assign_leaves(bins, log, has_categorical=hp.has_categorical,
                              bundle=bundle)
     return log._replace(leaf_value=leaf_out, leaf_sum=leaf_sum,
@@ -799,8 +929,11 @@ class SerialTreeLearner:
         if dataset.has_bundles:
             self.bundle = {k: jnp.asarray(v)
                            for k, v in dataset.bundle_maps().items()}
-        self.comm = Comm(comm_axis)
+        self.comm = self._make_comm(comm_axis)
         self._build = jax.jit(self.make_build_fn())
+
+    def _make_comm(self, axis: Optional[str]) -> Comm:
+        return Comm(axis)
 
     def use_partition(self) -> bool:
         """Partitioned (leaf-contiguous) builder unless disabled or the bin

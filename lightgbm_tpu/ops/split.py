@@ -151,8 +151,13 @@ def find_best_split(
     leaf_lower: jax.Array = jnp.float32(-jnp.inf),
     leaf_upper: jax.Array = jnp.float32(jnp.inf),
     rand_threshold: Optional[jax.Array] = None,  # (F,) extra-trees random bins
+    want_feature_gains: bool = False,
 ) -> SplitInfo:
-    """Best split over all features for one leaf's histogram."""
+    """Best split over all features for one leaf's histogram.
+
+    With ``want_feature_gains`` (static), returns only the per-feature max
+    gains (F,) — the voting-parallel learner's local vote input (reference:
+    voting_parallel_tree_learner.cpp:322 local top-k votes)."""
     num_feat, num_bin, _ = hist.shape
     b_iota = jnp.arange(num_bin, dtype=jnp.int32)
     bin_valid = b_iota[None, :] < meta.num_bins[:, None]            # (F, B)
@@ -254,6 +259,8 @@ def find_best_split(
     stacked = jnp.stack([num_gain, oh_gain, mvm_asc, mvm_desc], axis=0)  # (4, F, B)
     stacked = stacked * jnp.where(stacked > NEG_INF, meta.penalty[None, :, None], 1.0)
     stacked = jnp.where(feature_mask[None, :, None], stacked, NEG_INF)
+    if want_feature_gains:
+        return jnp.max(stacked, axis=(0, 2))                 # (F,)
     flat = stacked.reshape(-1)
     best_idx = jnp.argmax(flat)
     best_gain = flat[best_idx]

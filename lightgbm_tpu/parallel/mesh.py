@@ -1,4 +1,4 @@
-"""Device mesh + distributed (data-parallel) tree learner.
+"""Device mesh + distributed tree learners (data / feature / voting).
 
 TPU-native equivalent of the reference's distributed tree learners and
 Network layer (reference: src/treelearner/data_parallel_tree_learner.cpp,
@@ -7,19 +7,28 @@ src/network/network.cpp). The mapping (SURVEY.md §2.3):
 
 - machine list / sockets / MPI  ->  ``jax.sharding.Mesh`` over a 1-D
   ``data`` axis; XLA owns routing over ICI/DCN, no topology maps.
-- per-leaf histogram ReduceScatter + best-split allgather
-  (data_parallel_tree_learner.cpp:155-251)  ->  ``lax.psum`` of the
-  (F, B, 3) histogram inside ``shard_map``. Because the full split search
-  is replicated-cheap (O(F·B)) on TPU, the reduce-scatter + argmax-sync
-  two-step collapses into one psum; the feature-parallel and
-  voting-parallel learners' comm-volume optimizations become Pallas/async
-  refinements of the same seam rather than separate code paths.
+- the reference's 4x3 learner-type x device matrix collapses to ONE
+  builder (learner.build_tree_partitioned) parameterized by a ``Comm``
+  strategy (learner.Comm):
+  * data-parallel: rows sharded, per-leaf histograms psum'd, every shard
+    derives the same split (histogram ReduceScatter + best-split argmax
+    sync fold into one collective, data_parallel_tree_learner.cpp:155-251).
+    Comm per split round: one (G, Bm, 3) f32 allreduce of the smaller
+    child's histogram.
+  * feature-parallel: rows replicated, the split SEARCH is sharded by
+    feature ownership and only the winning SplitInfo is argmax-synced
+    (feature_parallel_tree_learner.cpp:40-84; SyncUpGlobalBestSplit,
+    parallel_tree_learner.h:191). Comm per round: O(B) — one SplitInfo.
+  * voting-parallel: rows sharded, histograms stay LOCAL; shards vote
+    their top-k features, the global top-2k features' histograms are
+    merged and searched (voting_parallel_tree_learner.cpp:151
+    GlobalVoting / PV-Tree). Comm per round: O(F) vote counts +
+    O(2*top_k * Bm * 3) merged rows — bounded as F grows.
 - rank row-partition (pre_partition)  ->  row sharding of the binned
   matrix: ``NamedSharding(mesh, P('data'))``.
 """
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional
 
 import jax
@@ -30,6 +39,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..config import Config
 from ..dataset import BinnedDataset
 from ..learner import Comm, SerialTreeLearner, TreeLog
+from ..utils.log import Log
 
 DATA_AXIS = "data"
 
@@ -45,55 +55,107 @@ def round_up(n: int, d: int) -> int:
     return ((n + d - 1) // d) * d
 
 
-class DataParallelTreeLearner(SerialTreeLearner):
-    """Row-sharded learner: bins and (g,h,cnt) live sharded over the mesh;
-    one tree grows with psum'd histograms (reference analog:
-    DataParallelTreeLearner, tree_learner=data)."""
+def _tree_log_specs(row_spec: P) -> TreeLog:
+    return TreeLog(
+        num_splits=P(), split_leaf=P(), feature=P(), bin=P(), kind=P(),
+        default_left=P(), gain=P(), left_sum=P(), right_sum=P(),
+        go_left=P(), miss_bin=P(), movable=P(), leaf_value=P(),
+        leaf_sum=P(), row_leaf=row_spec)
 
-    def __init__(self, config: Config, dataset: BinnedDataset, mesh: Mesh) -> None:
-        super().__init__(config, dataset, comm_axis=DATA_AXIS)
+
+class _MeshTreeLearner(SerialTreeLearner):
+    """Shared shard_map wiring for the distributed learners."""
+
+    comm_mode = "data"
+    rows_sharded = True
+
+    def __init__(self, config: Config, dataset: BinnedDataset,
+                 mesh: Mesh) -> None:
         self.mesh = mesh
-        d = mesh.devices.size
+        super().__init__(config, dataset, comm_axis=DATA_AXIS)
         n = dataset.num_data
-        self.padded_n = round_up(n, d)
-        bins_np = np.asarray(dataset.binned)
-        if self.padded_n != n:
-            bins_np = np.pad(bins_np, ((0, self.padded_n - n), (0, 0)))
+        d = mesh.devices.size
         self.row_sharding = NamedSharding(mesh, P(DATA_AXIS))
         self.rep_sharding = NamedSharding(mesh, P())
-        self.bins = jax.device_put(jnp.asarray(bins_np), self.row_sharding)
+        if self.rows_sharded:
+            self.padded_n = round_up(n, d)
+            bins_np = np.asarray(dataset.binned)
+            if self.padded_n != n:
+                bins_np = np.pad(bins_np, ((0, self.padded_n - n), (0, 0)))
+            self.bins = jax.device_put(jnp.asarray(bins_np), self.row_sharding)
+            row_spec = P(DATA_AXIS)
+        else:
+            self.padded_n = n
+            self.bins = jax.device_put(self.bins, self.rep_sharding)
+            row_spec = P()
 
+        if self.comm_mode != "data" and not self.use_partition():
+            Log.fatal("tree_learner=%s requires the partitioned builder "
+                      "(max_bin <= 256)", self.comm_mode)
         inner = self.make_build_fn()
+        data_spec = P(DATA_AXIS) if self.rows_sharded else P()
         sharded = jax.shard_map(
             inner, mesh=mesh,
-            in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(), P(), P()),
-            out_specs=TreeLog(
-                num_splits=P(), split_leaf=P(), feature=P(), bin=P(), kind=P(),
-                default_left=P(), gain=P(), left_sum=P(), right_sum=P(),
-                go_left=P(), miss_bin=P(), movable=P(), leaf_value=P(),
-                leaf_sum=P(), row_leaf=P(DATA_AXIS)),
+            in_specs=(data_spec, data_spec, P(), P(), P()),
+            out_specs=_tree_log_specs(row_spec),
             check_vma=False,
         )
         self._build = jax.jit(sharded)
 
-    def train(self, ghc: jax.Array, feature_mask: jax.Array, key: jax.Array) -> TreeLog:
+    def _make_comm(self, axis: Optional[str]) -> Comm:
+        return Comm(axis, mode=self.comm_mode,
+                    top_k=int(self.config.top_k),
+                    num_machines=int(self.mesh.devices.size))
+
+    def train(self, ghc: jax.Array, feature_mask: jax.Array,
+              key: jax.Array) -> TreeLog:
         n = self.dataset.num_data
-        if self.padded_n != n:
+        if self.rows_sharded and self.padded_n != n:
             ghc = jnp.pad(ghc, ((0, self.padded_n - n), (0, 0)))
-        ghc = jax.device_put(ghc, self.row_sharding)
+        sharding = self.row_sharding if self.rows_sharded else self.rep_sharding
+        ghc = jax.device_put(ghc, sharding)
         log = self._build(self.bins, ghc, self.meta, feature_mask, key)
-        if self.padded_n != n:
+        if self.rows_sharded and self.padded_n != n:
             log = log._replace(row_leaf=log.row_leaf[:n])
         return log
+
+
+class DataParallelTreeLearner(_MeshTreeLearner):
+    """tree_learner=data: rows sharded, histograms globally reduced
+    (reference: DataParallelTreeLearner)."""
+
+    comm_mode = "data"
+    rows_sharded = True
+
+
+class FeatureParallelTreeLearner(_MeshTreeLearner):
+    """tree_learner=feature: data replicated, split search sharded over
+    features, winner synced — no data movement, comm is one SplitInfo per
+    round (reference: FeatureParallelTreeLearner)."""
+
+    comm_mode = "feature"
+    rows_sharded = False
+
+
+class VotingParallelTreeLearner(_MeshTreeLearner):
+    """tree_learner=voting: data-parallel with top-k feature voting to
+    bound comm volume as features grow (reference:
+    VotingParallelTreeLearner / PV-Tree)."""
+
+    comm_mode = "voting"
+    rows_sharded = True
 
 
 def create_tree_learner(config: Config, dataset: BinnedDataset,
                         mesh: Optional[Mesh] = None) -> SerialTreeLearner:
     """Factory (reference: src/treelearner/tree_learner.cpp:15
-    CreateTreeLearner). ``serial`` = single device; ``data``/``feature``/
-    ``voting`` = row-sharded mesh learner (feature- and voting-parallel
-    specializations share the psum seam; their comm-volume tricks are
-    device-side optimizations on TPU, not separate partitionings)."""
-    if config.tree_learner == "serial" or mesh is None or mesh.devices.size <= 1:
+    CreateTreeLearner)."""
+    kind = config.tree_learner
+    if kind == "serial" or mesh is None or mesh.devices.size <= 1:
         return SerialTreeLearner(config, dataset)
-    return DataParallelTreeLearner(config, dataset, mesh)
+    cls = {"data": DataParallelTreeLearner,
+           "feature": FeatureParallelTreeLearner,
+           "voting": VotingParallelTreeLearner}.get(kind)
+    if cls is None:
+        Log.fatal("Unknown tree_learner: %s", kind)
+    return cls(config, dataset, mesh)
