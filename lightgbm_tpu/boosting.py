@@ -120,6 +120,7 @@ class GBDT:
             self.train_score.score = self.train_score.score + jnp.asarray(
                 base, jnp.float32)
         self._inbag = jnp.ones((n,), jnp.float32)
+        self._cegb_used = np.zeros(train_set.num_features, dtype=bool)
         self._setup_grad_fn()
 
     def _setup_grad_fn(self) -> None:
@@ -270,21 +271,110 @@ class GBDT:
                 g = g.reshape(self.train_set.num_data, self.num_class)
                 h = h.reshape(self.train_set.num_data, self.num_class)
         self._bagging(it, g, h)
+        self._last_grad, self._last_hess = g, h
         fmask = self._feature_mask(it)
         any_nonconstant = False
         for k in range(self.num_tree_per_iteration):
             ghc = self._tree_channels(g, h, k)
             key = jax.random.fold_in(self._key, it * 131 + k)
-            log = self.learner.train(ghc, fmask, key)
+            log = self.learner.train(ghc, fmask, key,
+                                     jnp.asarray(self._cegb_used))
             tree = self._finalize_tree(log, k)
             self.models.append(tree)
+            self._note_used_features(tree)
             if tree.num_leaves > 1:
                 any_nonconstant = True
         self.iter_ += 1
         return not any_nonconstant
 
+    def _note_used_features(self, tree: Tree) -> None:
+        """Track model-level feature usage for CEGB coupled penalties
+        (reference: cost_effective_gradient_boosting.hpp
+        is_feature_used_in_split_)."""
+        if tree.num_leaves > 1 and self.train_set is not None:
+            for f in tree.split_feature[:tree.num_internal]:
+                inner = self.train_set.inner_feature_index(int(f))
+                if inner >= 0:
+                    self._cegb_used[inner] = True
+
     def _shrinkage_rate(self, log: TreeLog) -> float:
         return float(self.config.learning_rate)
+
+    def _fit_linear_tree(self, tree: Tree, log: TreeLog, grad, hess,
+                         class_id: int, rate: float) -> None:
+        """Fit ridge linear models in the leaves (reference:
+        LinearTreeLearner::CalculateLinear, linear_tree_learner.cpp:7):
+        solve -(Z^T H Z + lambda I') beta = Z^T g per leaf over the leaf's
+        branch numerical features; rows with NaN in those features are
+        excluded; under-determined leaves keep the plain output. The first
+        iteration only copies constants (the reference skips the fit)."""
+        from .ops.binning import BIN_CATEGORICAL
+
+        ds = self.train_set
+        tree.is_linear = True
+        # leaf_value is already shrunk; solved coefficients get the same
+        # shrinkage below (reference applies Tree::Shrinkage to both)
+        tree.leaf_const = tree.leaf_value.copy()
+        if len(self.models) <= self.num_tree_per_iteration - 1 \
+                or tree.num_leaves <= 1 or ds.raw_numeric is None:
+            return
+        lam = float(self.config.linear_lambda)
+        leaf = np.asarray(log.row_leaf)
+        gk = np.asarray(grad if grad.ndim == 1 else grad[:, class_id],
+                        np.float64)
+        hk = np.asarray(hess if hess.ndim == 1 else hess[:, class_id],
+                        np.float64)
+        X = ds.raw_numeric
+        for l in range(tree.num_leaves):
+            feats = [int(f) for f in tree.branch_features(l)
+                     if ds.inner_feature_index(int(f)) >= 0
+                     and ds.bin_mappers[ds.inner_feature_index(int(f))]
+                     .bin_type != BIN_CATEGORICAL]
+            rows = np.flatnonzero(leaf == l)
+            if not feats or len(rows) < len(feats) + 1:
+                continue
+            Z = X[np.ix_(rows, feats)].astype(np.float64)
+            ok = ~np.isnan(Z).any(axis=1)
+            if int(ok.sum()) < len(feats) + 1:
+                continue
+            Zk = np.concatenate([Z[ok], np.ones((int(ok.sum()), 1))], axis=1)
+            hr = hk[rows][ok]
+            A = Zk.T @ (Zk * hr[:, None])
+            A[np.arange(len(feats)), np.arange(len(feats))] += lam
+            b = Zk.T @ gk[rows][ok]
+            try:
+                beta = -np.linalg.solve(A, b)
+            except np.linalg.LinAlgError:
+                continue
+            keep = np.abs(beta[:-1]) > 1e-35
+            tree.leaf_features[l] = np.asarray(feats, np.int64)[keep]
+            tree.leaf_coeff[l] = beta[:-1][keep] * rate
+            tree.leaf_const[l] = float(beta[-1]) * rate
+
+    def _linear_score_updates(self, tree: Tree, log: TreeLog,
+                              class_id: int) -> None:
+        """Score updates for linear leaves need raw feature values, so they
+        run on host (reference: score updates via Tree::AddPredictionToScore
+        with PredictionFunLinear, tree.cpp:246)."""
+        leaf = np.asarray(log.row_leaf)
+        vals = tree.linear_predict(self.train_set.raw_numeric.astype(np.float64),
+                                   leaf)
+        self.train_score.score = self.train_score.score + (
+            jnp.asarray(vals, jnp.float32) if self.num_tree_per_iteration == 1
+            else jnp.zeros_like(self.train_score.score)
+            .at[:, class_id].set(jnp.asarray(vals, jnp.float32)))
+        for _, vset, vscore in self.valid_sets:
+            _, vleaf = self._route_tree_device(tree, vset)
+            if vset.raw_numeric is None:
+                Log.warning("valid set lacks raw features for linear trees")
+                continue
+            vvals = tree.linear_predict(vset.raw_numeric.astype(np.float64),
+                                        np.asarray(vleaf))
+            vscore.score = vscore.score + (
+                jnp.asarray(vvals, jnp.float32)
+                if self.num_tree_per_iteration == 1
+                else jnp.zeros_like(vscore.score)
+                .at[:, class_id].set(jnp.asarray(vvals, jnp.float32)))
 
     def _finalize_tree(self, log: TreeLog, class_id: int) -> Tree:
         rate = self._shrinkage_rate(log)
@@ -307,6 +397,12 @@ class GBDT:
             leaf_vals_dev = log.leaf_value * jnp.float32(rate)
             tree = self.learner.log_to_tree(log)
             tree.apply_shrinkage(rate)
+        if self.config.linear_tree and not self.objective.need_renew:
+            self._fit_linear_tree(tree, log, self._last_grad, self._last_hess,
+                                  class_id, rate)
+            if tree.num_leaves > 1:
+                self._linear_score_updates(tree, log, class_id)
+            return tree
         # score updates: train via the partition the learner already holds
         # (reference: score_updater.hpp:88), valid via device routing.
         # Constant (1-leaf) trees contribute nothing (reference:
@@ -336,6 +432,7 @@ class GBDT:
         leaf renewal, no valid sets, single-device learner."""
         from .parallel.mesh import _MeshTreeLearner
         return (type(self) is GBDT
+                and not self.config.linear_tree
                 and self.objective is not None
                 and self.objective.name != "none"
                 and not self.objective.need_renew
@@ -430,7 +527,8 @@ class GBDT:
         K = self.num_tree_per_iteration
         n = X.shape[0]
         models = self.models[start * K:end * K]
-        if n >= self.DEVICE_PREDICT_MIN_ROWS and models:
+        has_linear = any(getattr(t, "is_linear", False) for t in models)
+        if n >= self.DEVICE_PREDICT_MIN_ROWS and models and not has_linear:
             from .ops.predict import pack_splits, predict_raw
 
             key = (start, end, len(self.models),
@@ -702,7 +800,8 @@ class RF(GBDT):
         for k in range(self.num_tree_per_iteration):
             ghc = self._tree_channels(g, h, k)
             key = jax.random.fold_in(self._key, it * 131 + k)
-            log = self.learner.train(ghc, fmask, key)
+            log = self.learner.train(ghc, fmask, key,
+                                     jnp.asarray(self._cegb_used))
             tree = self.learner.log_to_tree(log)
             # averaged score: rescale previous sum then add (ref rf.hpp)
             self.models.append(tree)

@@ -113,6 +113,9 @@ class BinnedDataset:
         self.feature_names: List[str] = []
         self.monotone_constraints: Optional[np.ndarray] = None
         self.feature_penalty: Optional[np.ndarray] = None
+        # raw numerical feature values, kept only for linear_tree
+        # (reference: Dataset::raw_data_, dataset.h numeric_feature_map_)
+        self.raw_numeric: Optional[np.ndarray] = None   # (N, F) f32, NaN kept
 
     # -- accessors used by the learners --
     @property
@@ -266,6 +269,8 @@ def construct_dataset(
         ds.feature_penalty = reference.feature_penalty
         ds.binned = _extract_binned(X, ds)
         ds.metadata = Metadata(num_data, label, weight, group, init_score)
+        if config.linear_tree:
+            ds.raw_numeric = _raw_numeric(X, ds)
         return ds
 
     cat_idx = set(_resolve_categorical(categorical_feature if categorical_feature is not None
@@ -361,6 +366,8 @@ def construct_dataset(
 
     ds.binned = _extract_binned(X, ds)
     ds.metadata = Metadata(num_data, label, weight, group, init_score)
+    if config.linear_tree:
+        ds.raw_numeric = _raw_numeric(X, ds)
     return ds
 
 
@@ -508,6 +515,25 @@ def _extract_binned(X, ds: BinnedDataset) -> np.ndarray:
                     out[nz, gid] = bb[nz].astype(dtype)
                 else:
                     out[:, gid] = b.astype(dtype)
+    return out
+
+
+def _raw_numeric(X, ds: BinnedDataset) -> np.ndarray:
+    """Raw values of the used features for linear-leaf fitting (reference:
+    dataset.cpp raw_data_ kept when linear_tree). Indexed by REAL feature."""
+    n = X.shape[0]
+    total = ds.num_total_features
+    out = np.zeros((n, total), dtype=np.float32)
+    if _is_sparse(X):
+        import scipy.sparse as sp
+        Xc = sp.csc_matrix(X)
+        for f in ds.used_feature_indices:
+            col = Xc.getcol(f)
+            out[col.indices, f] = col.data
+    else:
+        Xv = np.asarray(X, dtype=np.float32)
+        for f in ds.used_feature_indices:
+            out[:, f] = Xv[:, f]
     return out
 
 

@@ -140,7 +140,7 @@ class FusedTrainer:
         meta = learner.meta
         build = learner.make_build_fn()
 
-        def one_iter(score, key, it):
+        def one_iter(score, cegb_used, key, it):
             if obj.needs_iter:
                 g, h = obj.get_gradients(score, it)
             else:
@@ -166,7 +166,11 @@ class FusedTrainer:
                 else:
                     cnt = jnp.ones_like(gc)
                 ghc = jnp.stack([gc, hc, cnt], axis=1)
-                log = build(bins, ghc, meta, fmask, jax.random.fold_in(key, it * 131 + c))
+                log = build(bins, ghc, meta, fmask,
+                            jax.random.fold_in(key, it * 131 + c), cegb_used)
+                valid_r = jnp.arange(log.feature.shape[0]) < log.num_splits
+                cegb_used = cegb_used.at[
+                    jnp.where(valid_r, log.feature, nf)].set(True, mode="drop")
                 vals = log.leaf_value * jnp.float32(lr)
                 upd = leaf_values_by_row(vals, log.row_leaf, vals.shape[0]) \
                     * (log.num_splits > 0)
@@ -176,13 +180,15 @@ class FusedTrainer:
                     score = score + upd
                 logs.append(_small(log))
             stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *logs) if K > 1 else logs[0]
-            return score, stacked
+            return score, cegb_used, stacked
 
         @jax.jit
-        def run_block(score, key, it0):
-            def body(score, i):
-                return one_iter(score, key, it0 + i)
-            return jax.lax.scan(body, score, jnp.arange(k))
+        def run_block(score, cegb_used, key, it0):
+            def body(carry, i):
+                score, used = carry
+                score, used, stacked = one_iter(score, used, key, it0 + i)
+                return (score, used), stacked
+            return jax.lax.scan(body, (score, cegb_used), jnp.arange(k))
 
         self._fns[k] = run_block
         return run_block
@@ -198,8 +204,12 @@ class FusedTrainer:
         gbdt = self.gbdt
         fn = self._block_fn(k)
         it0 = gbdt.iter_
-        score, logs = fn(gbdt.train_score.score, gbdt._key, jnp.int32(it0))
+        import jax.numpy as _jnp
+        (score, used), logs = fn(gbdt.train_score.score,
+                                 _jnp.asarray(gbdt._cegb_used),
+                                 gbdt._key, jnp.int32(it0))
         gbdt.train_score.score = score
+        gbdt._cegb_used = np.asarray(used)
         host = jax.device_get(logs)
         K = gbdt.num_tree_per_iteration
         last_iter_constant = False

@@ -203,7 +203,7 @@ def _make_best_for(meta: FeatureMeta, hp: SplitHyper, key, feature_mask,
 
     def best_for(r, leaf, hist, parent_sum, parent_out, lower, upper,
                  used_row, extra_mask=None, want_feature_gains=False,
-                 use_hp=None):
+                 use_hp=None, cegb_delta=None):
         fmask, rand_thr = node_inputs(r, leaf)
         fmask = fmask & allowed_mask(used_row)
         if extra_mask is not None:
@@ -211,7 +211,8 @@ def _make_best_for(meta: FeatureMeta, hp: SplitHyper, key, feature_mask,
         return find_best_split(
             hist, parent_sum, meta, fmask, use_hp if use_hp is not None else hp,
             parent_output=parent_out, leaf_lower=lower, leaf_upper=upper,
-            rand_threshold=rand_thr, want_feature_gains=want_feature_gains)
+            rand_threshold=rand_thr, want_feature_gains=want_feature_gains,
+            cegb_delta=cegb_delta)
 
     return best_for
 
@@ -222,7 +223,8 @@ def build_tree(
     meta: FeatureMeta,
     feature_mask: jax.Array,  # (F,) bool, per-tree column sample
     key: jax.Array,           # PRNG for by-node sampling / extra-trees
-    hp: SplitHyper,
+    cegb_used: jax.Array,     # (F,) bool — accepted for signature parity
+    hp: SplitHyper,           # (CEGB needs tree_builder=partition)
     *,
     num_leaves: int,
     num_bin: int,
@@ -431,6 +433,7 @@ def build_tree_partitioned(
     meta: FeatureMeta,
     feature_mask: jax.Array,  # (F,) bool, per-tree column sample
     key: jax.Array,           # PRNG for by-node sampling / extra-trees
+    cegb_used: jax.Array,     # (F,) bool — features already used by the model
     hp: SplitHyper,
     *,
     num_leaves: int,
@@ -530,14 +533,26 @@ def build_tree_partitioned(
             min_data_in_leaf=hp.min_data_in_leaf / d,
             min_sum_hessian_in_leaf=hp.min_sum_hessian_in_leaf / d)
 
+    def cegb_penalty(tot_g, tree_used):
+        """Per-feature CEGB gain penalty (reference:
+        cost_effective_gradient_boosting.hpp:66 DetlaGain): split penalty
+        scales with the leaf's row count; coupled feature penalties apply
+        until the model first uses the feature."""
+        if not hp.use_cegb:
+            return None
+        return hp.cegb_tradeoff * (
+            hp.cegb_penalty_split * tot_g[2]
+            + meta.cegb_coupled * (~tree_used).astype(jnp.float32))
+
     def node_best(r, leaf, hg, tot_g, tot_l, parent_out, lower, upper,
-                  used_row):
+                  used_row, tree_used):
         """Best split for a node under the active comm strategy. ``hg`` is
         the (bundled) histogram — global for serial/data/feature, LOCAL for
         voting; ``tot_g``/``tot_l`` the node's global/local (g,h,cnt)."""
+        delta = cegb_penalty(tot_g, tree_used)
         if not voting:
             info = best_raw(r, leaf, feat_view(hg, tot_g), tot_g, parent_out,
-                            lower, upper, used_row)
+                            lower, upper, used_row, cegb_delta=delta)
             return comm.sync_split(info)
         # ---- voting parallel (reference: GlobalVoting,
         # voting_parallel_tree_learner.cpp:151,322) ----
@@ -560,7 +575,7 @@ def build_tree_partitioned(
         full = (selmat.T @ merged).reshape(fv_loc.shape)       # voted rows only
         selmask = jnp.any(selmat > 0.5, axis=0)
         return best_raw(r, leaf, full, tot_g, parent_out, lower, upper,
-                        used_row, extra_mask=selmask)
+                        used_row, extra_mask=selmask, cegb_delta=delta)
 
     # ---- init: root ----
     root_sum_loc = jnp.sum(ghc, axis=0)
@@ -580,11 +595,12 @@ def build_tree_partitioned(
     leaf_start = jnp.zeros((num_leaves,), jnp.int32).at[0].set(guard)
     leaf_cnt = jnp.zeros((num_leaves,), jnp.int32).at[0].set(n)
     leaf_parity = jnp.zeros((num_leaves,), jnp.int32)
+    tree_used0 = cegb_used.astype(bool)
     best = _empty_best(num_leaves, num_bin)
     best = _set_best(best, 0,
                      node_best(0, jnp.int32(0), root_hist, root_sum,
                                root_sum_loc, leaf_out[0], leaf_lower[0],
-                               leaf_upper[0], leaf_used[0]))
+                               leaf_upper[0], leaf_used[0], tree_used0))
     log = TreeLog(
         num_splits=jnp.int32(0),
         split_leaf=jnp.zeros((max_splits,), jnp.int32),
@@ -611,10 +627,11 @@ def build_tree_partitioned(
     force_live = jnp.bool_(n_forced > 0)
     carry0 = (jnp.int32(0), work, leaf_start, leaf_cnt, leaf_parity,
               hist_pool, leaf_sum, leaf_sum_loc, leaf_out, leaf_depth,
-              leaf_lower, leaf_upper, best, log, leaf_used, force_live)
+              leaf_lower, leaf_upper, best, log, leaf_used, tree_used0,
+              force_live)
 
     def cond(carry):
-        r, best, log, force_live = carry[0], carry[12], carry[13], carry[15]
+        r, best, log, force_live = carry[0], carry[12], carry[13], carry[16]
         forcing = force_live & (r < n_forced) if n_forced else False
         return (log.num_splits < max_splits) & (r < max_splits + n_forced) \
             & ((jnp.max(best.gain) > 0.0) | forcing)
@@ -622,7 +639,7 @@ def build_tree_partitioned(
     def body(carry):
         (r, work, leaf_start, leaf_cnt, leaf_parity, hist_pool, leaf_sum,
          leaf_sum_loc, leaf_out, leaf_depth, leaf_lower, leaf_upper, best,
-         log, leaf_used, force_live) = carry
+         log, leaf_used, tree_used, force_live) = carry
         leaf = jnp.argmax(best.gain).astype(jnp.int32)
         info: SplitInfo = jax.tree.map(lambda a: a[leaf], best)
         if n_forced:
@@ -708,12 +725,20 @@ def build_tree_partitioned(
             .at[new_leaf].set(sel(d, leaf_depth[new_leaf]))
         if hp.has_monotone:
             mono = meta.monotone[info.feature]
-            mid = (info.left_output + info.right_output) * 0.5
+            # basic bounds both children by the split midpoint; intermediate
+            # bounds each child by the sibling's output — tighter, giving
+            # better-quality constrained trees (reference:
+            # monotone_constraints.hpp:327 Basic vs :463 Intermediate)
+            if hp.mono_intermediate:
+                bl = info.right_output   # left child's bound
+                br = info.left_output    # right child's bound
+            else:
+                bl = br = (info.left_output + info.right_output) * 0.5
             lo_l, up_l = leaf_lower[leaf], leaf_upper[leaf]
-            new_up_l = jnp.where(mono > 0, jnp.minimum(up_l, mid), up_l)
-            new_lo_r = jnp.where(mono > 0, jnp.maximum(lo_l, mid), lo_l)
-            new_lo_l = jnp.where(mono < 0, jnp.maximum(lo_l, mid), lo_l)
-            new_up_r = jnp.where(mono < 0, jnp.minimum(up_l, mid), up_l)
+            new_up_l = jnp.where(mono > 0, jnp.minimum(up_l, bl), up_l)
+            new_lo_r = jnp.where(mono > 0, jnp.maximum(lo_l, br), lo_l)
+            new_lo_l = jnp.where(mono < 0, jnp.maximum(lo_l, bl), lo_l)
+            new_up_r = jnp.where(mono < 0, jnp.minimum(up_l, br), up_l)
             leaf_lower = leaf_lower.at[leaf].set(sel(new_lo_l, lo_l)) \
                 .at[new_leaf].set(sel(new_lo_r, leaf_lower[new_leaf]))
             leaf_upper = leaf_upper.at[leaf].set(sel(new_up_l, up_l)) \
@@ -744,13 +769,15 @@ def build_tree_partitioned(
         used_new = leaf_used[leaf].at[info.feature].set(True)
         leaf_used = leaf_used.at[leaf].set(sel(used_new, leaf_used[leaf])) \
             .at[new_leaf].set(sel(used_new, leaf_used[new_leaf]))
+        tree_used = tree_used.at[info.feature].set(
+            sel(jnp.bool_(True), tree_used[info.feature]))
 
         info_l = node_best(r, leaf, hist_left, info.left_sum, loc_left,
                            leaf_out[leaf], leaf_lower[leaf],
-                           leaf_upper[leaf], used_new)
+                           leaf_upper[leaf], used_new, tree_used)
         info_r = node_best(r, new_leaf, hist_right, info.right_sum, loc_right,
                            leaf_out[new_leaf], leaf_lower[new_leaf],
-                           leaf_upper[new_leaf], used_new)
+                           leaf_upper[new_leaf], used_new, tree_used)
         gate_l = depth_ok(leaf_depth[leaf]) & valid
         gate_r = depth_ok(leaf_depth[new_leaf]) & valid
         info_l = info_l._replace(gain=jnp.where(gate_l, info_l.gain, -jnp.inf))
@@ -764,10 +791,11 @@ def build_tree_partitioned(
 
         return (r + 1, work, leaf_start, leaf_cnt, leaf_parity, hist_pool,
                 leaf_sum, leaf_sum_loc, leaf_out, leaf_depth, leaf_lower,
-                leaf_upper, best, log, leaf_used, force_live)
+                leaf_upper, best, log, leaf_used, tree_used, force_live)
 
     carry = jax.lax.while_loop(cond, body, carry0)
-    (_, _, _, _, _, _, leaf_sum, _, leaf_out, _, _, _, _, log, _, _) = carry
+    (_, _, _, _, _, _, leaf_sum, _, leaf_out, _, _, _, _, log, _, _,
+     _) = carry
     row_leaf = assign_leaves(bins, log, has_categorical=hp.has_categorical,
                              bundle=bundle)
     return log._replace(leaf_value=leaf_out, leaf_sum=leaf_sum,
@@ -894,6 +922,14 @@ class SerialTreeLearner:
         pen = np.ones(dataset.num_features, dtype=np.float32)
         if dataset.feature_penalty is not None:
             pen = dataset.feature_penalty.astype(np.float32)
+        cegb_coupled = np.zeros(dataset.num_features, dtype=np.float32)
+        if config.cegb_penalty_feature_coupled:
+            for i, f in enumerate(dataset.used_feature_indices):
+                if f < len(config.cegb_penalty_feature_coupled):
+                    cegb_coupled[i] = config.cegb_penalty_feature_coupled[f]
+        if config.cegb_penalty_feature_lazy:
+            Log.warning("cegb_penalty_feature_lazy is not supported; "
+                        "use cegb_penalty_feature_coupled")
         self.meta = FeatureMeta(
             num_bins=jnp.asarray(nb, jnp.int32),
             movable_missing=jnp.asarray(
@@ -905,6 +941,7 @@ class SerialTreeLearner:
                 [m.bin_type == BIN_CATEGORICAL for m in dataset.bin_mappers], bool),
             monotone=jnp.asarray(mono),
             penalty=jnp.asarray(pen),
+            cegb_coupled=jnp.asarray(cegb_coupled),
         )
         self.hp = SplitHyper(
             lambda_l1=float(config.lambda_l1),
@@ -921,7 +958,17 @@ class SerialTreeLearner:
             path_smooth=float(config.path_smooth),
             has_categorical=any(m.bin_type == BIN_CATEGORICAL for m in dataset.bin_mappers),
             has_monotone=dataset.monotone_constraints is not None,
+            mono_intermediate=config.monotone_constraints_method
+            in ("intermediate", "advanced"),
+            cegb_tradeoff=float(config.cegb_tradeoff),
+            cegb_penalty_split=float(config.cegb_penalty_split),
+            use_cegb=bool(config.cegb_penalty_split > 0
+                          or config.cegb_penalty_feature_coupled
+                          or config.cegb_tradeoff < 1.0),
         )
+        if config.monotone_constraints_method == "advanced":
+            Log.warning("monotone_constraints_method=advanced is not "
+                        "implemented; using intermediate")
         self.bins = jnp.asarray(dataset.binned)
         self.num_bin_hist = int(max(2, dataset.group_num_bins().max()
                                     if dataset.num_groups else 2))
@@ -1057,9 +1104,13 @@ class SerialTreeLearner:
         return (jnp.asarray(leaves, jnp.int32), jnp.asarray(feats, jnp.int32),
                 jnp.asarray(bins_, jnp.int32))
 
-    def train(self, ghc: jax.Array, feature_mask: jax.Array, key: jax.Array) -> TreeLog:
+    def train(self, ghc: jax.Array, feature_mask: jax.Array, key: jax.Array,
+              cegb_used: Optional[jax.Array] = None) -> TreeLog:
         """One tree from (grad, hess, inbag) channels. Returns the device log."""
-        return self._build(self.bins, ghc, self.meta, feature_mask, key)
+        if cegb_used is None:
+            cegb_used = jnp.zeros((self.dataset.num_features,), bool)
+        return self._build(self.bins, ghc, self.meta, feature_mask, key,
+                           cegb_used)
 
     def log_to_tree(self, log: TreeLog) -> Tree:
         """Pull the split log to host and rebuild the Tree model.

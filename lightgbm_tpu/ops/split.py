@@ -42,6 +42,7 @@ class FeatureMeta(NamedTuple):
     is_categorical: jax.Array  # bool
     monotone: jax.Array        # int8 in {-1, 0, +1}
     penalty: jax.Array         # float32 split-gain multiplier (feature_contri)
+    cegb_coupled: jax.Array    # float32 per-feature coupled CEGB penalty
 
 
 class SplitHyper(NamedTuple):
@@ -61,6 +62,14 @@ class SplitHyper(NamedTuple):
     path_smooth: float = 0.0
     has_categorical: bool = False
     has_monotone: bool = False
+    # monotone constraint propagation method: basic bounds children by the
+    # split midpoint; intermediate by the sibling's output
+    # (reference: monotone_constraints.hpp:327 Basic, :463 Intermediate)
+    mono_intermediate: bool = False
+    # CEGB (reference: cost_effective_gradient_boosting.hpp:66 DetlaGain)
+    cegb_tradeoff: float = 1.0
+    cegb_penalty_split: float = 0.0
+    use_cegb: bool = False
 
 
 class SplitInfo(NamedTuple):
@@ -152,6 +161,7 @@ def find_best_split(
     leaf_upper: jax.Array = jnp.float32(jnp.inf),
     rand_threshold: Optional[jax.Array] = None,  # (F,) extra-trees random bins
     want_feature_gains: bool = False,
+    cegb_delta: Optional[jax.Array] = None,      # (F,) CEGB gain penalties
 ) -> SplitInfo:
     """Best split over all features for one leaf's histogram.
 
@@ -258,6 +268,9 @@ def find_best_split(
     # ---------- combine ----------
     stacked = jnp.stack([num_gain, oh_gain, mvm_asc, mvm_desc], axis=0)  # (4, F, B)
     stacked = stacked * jnp.where(stacked > NEG_INF, meta.penalty[None, :, None], 1.0)
+    if hp.use_cegb and cegb_delta is not None:
+        stacked = jnp.where(stacked > NEG_INF,
+                            stacked - cegb_delta[None, :, None], stacked)
     stacked = jnp.where(feature_mask[None, :, None], stacked, NEG_INF)
     if want_feature_gains:
         return jnp.max(stacked, axis=(0, 2))                 # (F,)

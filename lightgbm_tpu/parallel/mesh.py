@@ -96,7 +96,7 @@ class _MeshTreeLearner(SerialTreeLearner):
         data_spec = P(DATA_AXIS) if self.rows_sharded else P()
         sharded = jax.shard_map(
             inner, mesh=mesh,
-            in_specs=(data_spec, data_spec, P(), P(), P()),
+            in_specs=(data_spec, data_spec, P(), P(), P(), P()),
             out_specs=_tree_log_specs(row_spec),
             check_vma=False,
         )
@@ -107,14 +107,17 @@ class _MeshTreeLearner(SerialTreeLearner):
                     top_k=int(self.config.top_k),
                     num_machines=int(self.mesh.devices.size))
 
-    def train(self, ghc: jax.Array, feature_mask: jax.Array,
-              key: jax.Array) -> TreeLog:
+    def train(self, ghc: jax.Array, feature_mask: jax.Array, key: jax.Array,
+              cegb_used=None) -> TreeLog:
         n = self.dataset.num_data
+        if cegb_used is None:
+            cegb_used = jnp.zeros((self.dataset.num_features,), bool)
         if self.rows_sharded and self.padded_n != n:
             ghc = jnp.pad(ghc, ((0, self.padded_n - n), (0, 0)))
         sharding = self.row_sharding if self.rows_sharded else self.rep_sharding
         ghc = jax.device_put(ghc, sharding)
-        log = self._build(self.bins, ghc, self.meta, feature_mask, key)
+        log = self._build(self.bins, ghc, self.meta, feature_mask, key,
+                          cegb_used)
         if self.rows_sharded and self.padded_n != n:
             log = log._replace(row_leaf=log.row_leaf[:n])
         return log

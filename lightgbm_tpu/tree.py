@@ -52,6 +52,12 @@ class Tree:
         self.cat_threshold: Dict[int, np.ndarray] = {}
         self.shrinkage: float = 1.0
         self.num_cat: int = 0
+        # linear leaves (reference: tree.h leaf_const_/leaf_coeff_/
+        # leaf_features_, fit by LinearTreeLearner::CalculateLinear)
+        self.is_linear: bool = False
+        self.leaf_const: np.ndarray = np.zeros(num_leaves, dtype=np.float64)
+        self.leaf_coeff: Dict[int, np.ndarray] = {}
+        self.leaf_features: Dict[int, np.ndarray] = {}  # real feature idx
 
     # ------------------------------------------------------------------ build
     @classmethod
@@ -168,7 +174,11 @@ class Tree:
         X = np.asarray(X, dtype=np.float64)
         n = X.shape[0]
         if self.num_leaves <= 1:
+            if self.is_linear:
+                return np.full(n, self.leaf_const[0], dtype=np.float64)
             return np.full(n, self.leaf_value[0], dtype=np.float64)
+        if self.is_linear:
+            return self.linear_predict(X, self.predict_leaf_index(X))
         node = np.zeros(n, dtype=np.int64)  # >=0 internal, <0 leaf (~leaf)
         active = node >= 0
         while np.any(active):
@@ -253,6 +263,41 @@ class Tree:
                     kind=kind, default_left=default_left,
                     missing_type=missing_type, cat_values=cat_values,
                     leaf_of_slot=leaf_of_slot)
+
+    def branch_features(self, leaf: int) -> np.ndarray:
+        """Real feature indices on the path from the root to ``leaf``
+        (reference: tree.h branch_features with track_branch_features)."""
+        feats = []
+        nd = int(self.leaf_parent[leaf])
+        seen = set()
+        while nd >= 0:
+            f = int(self.split_feature[nd])
+            if f not in seen:
+                seen.add(f)
+                feats.append(f)
+            # walk up: find the parent of nd
+            up = np.flatnonzero((self.left_child == nd) | (self.right_child == nd))
+            nd = int(up[0]) if len(up) else -1
+        return np.asarray(sorted(feats), dtype=np.int64)
+
+    def linear_predict(self, X: np.ndarray, leaf: np.ndarray) -> np.ndarray:
+        """Linear-leaf outputs for rows routed to ``leaf`` (reference:
+        tree.h:127 — const + coeffs . x; rows with NaN in any used feature
+        fall back to the plain leaf value)."""
+        out = self.leaf_const[leaf].copy()
+        for l in range(self.num_leaves):
+            m = leaf == l
+            if not np.any(m):
+                continue
+            feats = self.leaf_features.get(l)
+            if feats is None or len(feats) == 0:
+                continue
+            Z = X[np.ix_(m, feats)]
+            nan_rows = np.isnan(Z).any(axis=1)
+            vals = self.leaf_const[l] + Z @ self.leaf_coeff[l]
+            vals = np.where(nan_rows, self.leaf_value[l], vals)
+            out[m] = vals
+        return out
 
     def apply_shrinkage(self, rate: float) -> None:
         """(reference: tree.h:187 Shrinkage)"""
@@ -358,6 +403,19 @@ class Tree:
                 cat_items = ["%d:%s" % (k, ",".join(str(c) for c in v))
                              for k, v in sorted(self.cat_threshold.items())]
                 lines.append("cat_threshold=" + ";".join(cat_items))
+        if self.is_linear:
+            nf = [len(self.leaf_features.get(l, ())) for l in range(self.num_leaves)]
+            feats, coefs = [], []
+            for l in range(self.num_leaves):
+                feats.extend(int(f) for f in self.leaf_features.get(l, ()))
+                coefs.extend(float(c) for c in self.leaf_coeff.get(l, ()))
+            lines += [
+                "is_linear=1",
+                "leaf_const=" + " ".join("%.17g" % v for v in self.leaf_const),
+                "num_features=" + " ".join(str(v) for v in nf),
+                "leaf_features=" + " ".join(str(v) for v in feats),
+                "leaf_coeff=" + " ".join("%.17g" % v for v in coefs),
+            ]
         return "\n".join(lines)
 
     @classmethod
@@ -396,4 +454,17 @@ class Tree:
                     k, cats = item.split(":")
                     t.cat_threshold[int(k)] = np.asarray(
                         [int(c) for c in cats.split(",") if c], dtype=np.int64)
+        if kv.get("is_linear", "0").strip() == "1":
+            t.is_linear = True
+            t.leaf_const = arr("leaf_const", np.float64, L)
+            nf = arr("num_features", np.int64, L).astype(int)
+            feats = arr("leaf_features", np.int64, int(nf.sum()))
+            coefs = arr("leaf_coeff", np.float64, int(nf.sum()))
+            pos = 0
+            for l in range(L):
+                k = int(nf[l])
+                if k:
+                    t.leaf_features[l] = feats[pos:pos + k].astype(np.int64)
+                    t.leaf_coeff[l] = coefs[pos:pos + k]
+                pos += k
         return t

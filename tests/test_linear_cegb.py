@@ -1,0 +1,91 @@
+"""Linear trees, CEGB penalties, monotone constraint methods.
+
+Reference: src/treelearner/linear_tree_learner.cpp (Eigen per-leaf ridge),
+cost_effective_gradient_boosting.hpp:66 DetlaGain,
+monotone_constraints.hpp:327/:463 Basic/Intermediate.
+"""
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+
+def test_linear_tree_beats_plain_on_piecewise_linear(rng):
+    n = 3000
+    X = rng.rand(n, 4) * 4
+    y = 2.0 * X[:, 0] + 2 * np.sin(3 * X[:, 1]) + 0.1 * rng.randn(n)
+    base = {"objective": "regression", "num_leaves": 8, "verbosity": -1,
+            "metric": ["l2"], "learning_rate": 0.2, "min_data_in_leaf": 20}
+    plain = lgb.train(dict(base), lgb.Dataset(X, label=y), num_boost_round=12)
+    lin_p = dict(base, linear_tree=True, linear_lambda=0.01)
+    linear = lgb.train(lin_p, lgb.Dataset(X, label=y,
+                                          params={"linear_tree": True}),
+                       num_boost_round=12)
+    (_, _, l2_plain, _), = plain.eval_train()
+    (_, _, l2_lin, _), = linear.eval_train()
+    assert l2_lin < l2_plain * 0.8
+    # predict consistency with the training-time scores
+    tr = np.asarray(linear.inner.train_score.score)
+    np.testing.assert_allclose(linear.predict(X, raw_score=True), tr,
+                               atol=1e-4)
+    # text round trip preserves the linear leaves
+    re = lgb.Booster(model_str=linear.model_to_string())
+    np.testing.assert_allclose(re.predict(X[:200]), linear.predict(X[:200]),
+                               atol=1e-10)
+    assert any(t.is_linear for t in linear.inner.models)
+
+
+def test_linear_tree_nan_fallback(rng):
+    n = 2000
+    X = rng.rand(n, 3) * 2
+    y = X[:, 0] * 3 + 0.05 * rng.randn(n)
+    X[rng.rand(n) < 0.1, 0] = np.nan
+    p = {"objective": "regression", "num_leaves": 6, "verbosity": -1,
+         "linear_tree": True, "min_data_in_leaf": 10}
+    bst = lgb.train(p, lgb.Dataset(X, label=y, params={"linear_tree": True}),
+                    num_boost_round=5)
+    pred = bst.predict(X)
+    assert np.isfinite(pred).all()
+
+
+def test_cegb_coupled_penalty_shrinks_feature_set(rng):
+    n, f = 2500, 12
+    X = rng.randn(n, f)
+    w = np.concatenate([[3.0, 2.0, 1.5], np.full(f - 3, 0.3)])
+    y = (X @ w > 0).astype(np.float64)
+    base = {"objective": "binary", "num_leaves": 15, "verbosity": -1}
+    plain = lgb.train(dict(base), lgb.Dataset(X, label=y), num_boost_round=8)
+    cegb = lgb.train(dict(base, cegb_penalty_feature_coupled=[5.0] * f),
+                     lgb.Dataset(X, label=y), num_boost_round=8)
+    used_plain = int((plain.feature_importance() > 0).sum())
+    used_cegb = int((cegb.feature_importance() > 0).sum())
+    assert used_cegb <= used_plain
+    assert used_cegb < f
+
+
+def test_cegb_split_penalty_shrinks_trees(rng):
+    n = 2500
+    X = rng.randn(n, 6)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+    base = {"objective": "binary", "num_leaves": 31, "verbosity": -1}
+    plain = lgb.train(dict(base), lgb.Dataset(X, label=y), num_boost_round=5)
+    cegb = lgb.train(dict(base, cegb_penalty_split=0.002),
+                     lgb.Dataset(X, label=y), num_boost_round=5)
+    leaves_plain = sum(t.num_leaves for t in plain.inner.models)
+    leaves_cegb = sum(t.num_leaves for t in cegb.inner.models)
+    assert leaves_cegb < leaves_plain
+
+
+def test_monotone_intermediate(rng):
+    n = 3000
+    X = rng.rand(n, 3)
+    y = 2 * X[:, 0] + 0.5 * np.sin(8 * X[:, 1]) + 0.1 * rng.randn(n)
+    grid = np.tile(np.linspace(0.02, 0.98, 25)[:, None], (1, 3)) * 0 + 0.5
+    grid[:, 0] = np.linspace(0.02, 0.98, 25)
+    for method in ("basic", "intermediate"):
+        p = {"objective": "regression", "num_leaves": 15, "verbosity": -1,
+             "monotone_constraints": [1, 0, 0],
+             "monotone_constraints_method": method,
+             "min_data_in_leaf": 10}
+        bst = lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=10)
+        pred = bst.predict(grid)
+        assert np.all(np.diff(pred) >= -1e-6), method
