@@ -21,6 +21,12 @@ from .utils.log import Log, LightGBMError
 try:  # full API surface; modules come online as the build proceeds
     from .basic import Booster, Dataset, register_logger
     from .engine import train, cv, CVBooster
+    from .plotting import (  # noqa: F401
+        create_tree_digraph,
+        plot_importance,
+        plot_metric,
+        plot_tree,
+    )
     from .callback import (
         early_stopping,
         log_evaluation,
@@ -54,4 +60,8 @@ __all__ = [
     "record_evaluation",
     "reset_parameter",
     "EarlyStopException",
+    "plot_importance",
+    "plot_metric",
+    "plot_tree",
+    "create_tree_digraph",
 ] + _SKLEARN

@@ -21,12 +21,44 @@ from .utils.log import Log, LightGBMError
 def _to_2d(data) -> np.ndarray:
     if hasattr(data, "toarray"):  # scipy sparse
         data = data.toarray()
-    if hasattr(data, "values") and not isinstance(data, np.ndarray):  # pandas
-        data = data.values
+    data = _frame_values(data)
     arr = np.asarray(data, dtype=np.float64)
     if arr.ndim == 1:
         arr = arr.reshape(-1, 1)
     return arr
+
+
+def _frame_values(data):
+    """pandas DataFrame -> float matrix; category columns become their codes
+    (reference: python-package/lightgbm/basic.py _data_from_pandas)."""
+    if hasattr(data, "dtypes") and hasattr(data, "columns") \
+            and not isinstance(data, np.ndarray):
+        import pandas as pd
+        out = np.empty((len(data), data.shape[1]), dtype=np.float64)
+        for j, col in enumerate(data.columns):
+            c = data[col]
+            if isinstance(c.dtype, pd.CategoricalDtype):
+                codes = c.cat.codes.to_numpy().astype(np.float64)
+                codes[codes < 0] = np.nan
+                out[:, j] = codes
+            else:
+                out[:, j] = pd.to_numeric(c, errors="coerce").to_numpy(
+                    dtype=np.float64)
+        return out
+    if hasattr(data, "values") and not isinstance(data, np.ndarray):
+        return data.values
+    return data
+
+
+def _pandas_categorical_columns(data):
+    """Indices of pandas category-dtype columns (categorical_feature='auto'
+    semantics of the reference python package)."""
+    if hasattr(data, "dtypes") and hasattr(data, "columns") \
+            and not isinstance(data, np.ndarray):
+        import pandas as pd
+        return [j for j, col in enumerate(data.columns)
+                if isinstance(data[col].dtype, pd.CategoricalDtype)]
+    return []
 
 
 def _to_1d(data) -> Optional[np.ndarray]:
@@ -118,7 +150,33 @@ class Dataset:
         if self._constructed is not None and self._used_params == merged:
             return self._constructed
         cfg = Config.from_params(merged)
-        X = _to_2d(self.data)
+        if isinstance(self.data, str):
+            # binary dataset cache (reference: LoadFromBinFile,
+            # dataset_loader.cpp:314); explicitly-passed metadata overrides
+            # the cached copy
+            from .dataset import Metadata as _Meta
+            from .dataset import load_binned
+            ds = load_binned(self.data)
+            if any(v is not None for v in
+                   (self.label, self.weight, self.group, self.init_score)):
+                md = _Meta(ds.num_data, _to_1d(self.label),
+                           _to_1d(self.weight), _to_1d(self.group),
+                           self.init_score)
+                for f in ("label", "weight", "init_score",
+                          "query_boundaries", "query_id"):
+                    v = getattr(md, f)
+                    if v is not None:
+                        setattr(ds.metadata, f, v)
+            if self.reference is not None:
+                Log.warning("reference= is ignored for binary-cache "
+                            "datasets (binning is already fixed)")
+            self._constructed = ds
+            self._used_params = merged
+            return self._constructed
+        if hasattr(self.data, "tocsc"):     # scipy sparse: stays O(nnz)
+            X = self.data
+        else:
+            X = _to_2d(self.data)
         feature_names = None
         if isinstance(self.feature_name, (list, tuple)):
             feature_names = list(self.feature_name)
@@ -126,7 +184,8 @@ class Dataset:
             feature_names = [str(c) for c in self.data.columns]
         cat = self.categorical_feature
         if cat == "auto":
-            cat = None
+            auto_cats = _pandas_categorical_columns(self.data)
+            cat = auto_cats if auto_cats else None
         ref_binned = self.reference.construct(params) if self.reference else None
         self._constructed = construct_dataset(
             X, cfg, label=self.label, weight=self.weight, group=self.group,
@@ -143,15 +202,11 @@ class Dataset:
                        group=group, init_score=init_score, params=params)
 
     def save_binary(self, filename: str) -> "Dataset":
-        """Cache the binned dataset (reference: Dataset::SaveBinaryFile,
-        dataset.h:441) — numpy npz instead of a custom binary layout."""
-        ds = self.construct()
-        np.savez_compressed(
-            filename,
-            binned=ds.binned,
-            label=ds.metadata.label if ds.metadata.label is not None else np.array([]),
-            used=np.asarray(ds.used_feature_indices),
-        )
+        """Cache the fully-constructed binned dataset (reference:
+        Dataset::SaveBinaryFile, dataset.h:441); ``Dataset(path)`` loads it
+        back without re-parsing or re-binning."""
+        from .dataset import save_binned
+        save_binned(self.construct(), filename)
         return self
 
 

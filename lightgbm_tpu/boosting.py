@@ -276,6 +276,7 @@ class GBDT:
         any_nonconstant = False
         for k in range(self.num_tree_per_iteration):
             ghc = self._tree_channels(g, h, k)
+            self._last_ghc = ghc
             key = jax.random.fold_in(self._key, it * 131 + k)
             log = self.learner.train(ghc, fmask, key,
                                      jnp.asarray(self._cegb_used))
@@ -320,10 +321,12 @@ class GBDT:
             return
         lam = float(self.config.linear_lambda)
         leaf = np.asarray(log.row_leaf)
-        gk = np.asarray(grad if grad.ndim == 1 else grad[:, class_id],
-                        np.float64)
-        hk = np.asarray(hess if hess.ndim == 1 else hess[:, class_id],
-                        np.float64)
+        # use the bagged/amplified channels the tree was grown on (reference
+        # fits over the bagged partition only; out-of-bag rows carry h=0
+        # here, excluding them from the normal equations)
+        ghc = np.asarray(self._last_ghc, np.float64)
+        gk, hk = ghc[:, 0], ghc[:, 1]
+        del grad, hess
         X = ds.raw_numeric
         for l in range(tree.num_leaves):
             feats = [int(f) for f in tree.branch_features(l)
@@ -364,9 +367,14 @@ class GBDT:
             else jnp.zeros_like(self.train_score.score)
             .at[:, class_id].set(jnp.asarray(vals, jnp.float32)))
         for _, vset, vscore in self.valid_sets:
-            _, vleaf = self._route_tree_device(tree, vset)
+            slot_vals, vleaf = self._route_tree_device(tree, vset)
             if vset.raw_numeric is None:
-                Log.warning("valid set lacks raw features for linear trees")
+                # no raw features (e.g. binary-cache valid set): fall back to
+                # the plain leaf outputs so metrics stay meaningful
+                Log.warning("valid set lacks raw features for linear trees; "
+                            "using plain leaf outputs for its scores")
+                vscore.add(slot_vals, vleaf, class_id,
+                           self.num_tree_per_iteration)
                 continue
             vvals = tree.linear_predict(vset.raw_numeric.astype(np.float64),
                                         np.asarray(vleaf))
@@ -805,6 +813,7 @@ class RF(GBDT):
             tree = self.learner.log_to_tree(log)
             # averaged score: rescale previous sum then add (ref rf.hpp)
             self.models.append(tree)
+            self._note_used_features(tree)
             self._accumulate_avg(tree, log, k)
             if tree.num_leaves > 1:
                 any_ok = True

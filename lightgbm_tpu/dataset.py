@@ -316,6 +316,8 @@ def construct_dataset(
         # features that cannot split given min_data_in_leaf are trivial
         min_split_data = int(config.min_data_in_leaf * sample_cnt / max(1, num_data))
 
+    forced_bounds = _load_forced_bins(config.forcedbins_filename, num_total)
+
     mappers: List[BinMapper] = []
     used: List[int] = []
     for f in range(num_total):
@@ -329,6 +331,7 @@ def construct_dataset(
             use_missing=config.use_missing,
             zero_as_missing=config.zero_as_missing,
             min_split_data=min_split_data,
+            forced_bounds=forced_bounds.get(f),
         )
         if m.is_trivial:
             continue
@@ -518,6 +521,27 @@ def _extract_binned(X, ds: BinnedDataset) -> np.ndarray:
     return out
 
 
+def _load_forced_bins(filename: str, num_features: int) -> Dict[int, list]:
+    """Forced bin upper bounds per feature (reference:
+    dataset_loader.cpp DatasetLoader::GetForcedBins; JSON list of
+    {"feature": i, "bin_upper_bound": [...]})."""
+    if not filename:
+        return {}
+    import json as _json
+    import os as _os
+    if not _os.path.exists(filename):
+        Log.warning("forcedbins file %s not found", filename)
+        return {}
+    with open(filename) as f:
+        spec = _json.load(f)
+    out: Dict[int, list] = {}
+    for item in spec:
+        fi = int(item.get("feature", -1))
+        if 0 <= fi < num_features:
+            out[fi] = [float(v) for v in item.get("bin_upper_bound", [])]
+    return out
+
+
 def _raw_numeric(X, ds: BinnedDataset) -> np.ndarray:
     """Raw values of the used features for linear-leaf fitting (reference:
     dataset.cpp raw_data_ kept when linear_tree). Indexed by REAL feature."""
@@ -540,3 +564,101 @@ def _raw_numeric(X, ds: BinnedDataset) -> np.ndarray:
 def _is_sparse(X) -> bool:
     return hasattr(X, "tocsc") and hasattr(X, "indptr") or \
         type(X).__module__.startswith("scipy.sparse")
+
+
+# ---------------------------------------------------------------------------
+# Binary dataset cache (reference: Dataset::SaveBinaryFile, dataset.h:441 +
+# DatasetLoader::LoadFromBinFile, dataset_loader.cpp:314): the binned matrix,
+# bin mappers, bundling structure and metadata round-trip through one npz so
+# repeated runs skip text parsing and bin finding entirely.
+# ---------------------------------------------------------------------------
+
+def save_binned(ds: BinnedDataset, filename: str) -> None:
+    import json as _json
+
+    mappers = [dict(
+        num_bins=m.num_bins, bin_type=m.bin_type, missing_type=m.missing_type,
+        is_trivial=m.is_trivial, upper_bounds=list(map(float, m.upper_bounds)),
+        categories=list(map(int, m.categories)), default_bin=m.default_bin,
+        most_freq_bin=m.most_freq_bin, missing_bin=m.missing_bin,
+        sparse_rate=m.sparse_rate, min_value=m.min_value, max_value=m.max_value,
+    ) for m in ds.bin_mappers]
+    groups = [dict(feature_indices=g.feature_indices,
+                   bin_offsets=g.bin_offsets, num_bins=g.num_bins)
+              for g in ds.groups]
+    meta = dict(
+        num_data=ds.num_data, num_total_features=ds.num_total_features,
+        used_feature_indices=list(ds.used_feature_indices),
+        feature_names=list(ds.feature_names), mappers=mappers, groups=groups,
+    )
+    md = ds.metadata
+    empty = np.array([])
+    np.savez_compressed(
+        filename,
+        header=np.frombuffer(_json.dumps(meta).encode(), dtype=np.uint8),
+        binned=ds.binned,
+        feature_to_group=ds.feature_to_group,
+        feature_group_offset=ds.feature_group_offset,
+        label=md.label if md.label is not None else empty,
+        weight=md.weight if md.weight is not None else empty,
+        init_score=md.init_score if md.init_score is not None else empty,
+        query_boundaries=md.query_boundaries
+        if md.query_boundaries is not None else empty,
+        monotone=ds.monotone_constraints
+        if ds.monotone_constraints is not None else empty,
+        penalty=ds.feature_penalty if ds.feature_penalty is not None else empty,
+    )
+
+
+def load_binned(filename: str) -> BinnedDataset:
+    import json as _json
+
+    z = np.load(filename, allow_pickle=False)
+    meta = _json.loads(bytes(z["header"]).decode())
+    ds = BinnedDataset()
+    ds.num_data = int(meta["num_data"])
+    ds.num_total_features = int(meta["num_total_features"])
+    ds.used_feature_indices = [int(i) for i in meta["used_feature_indices"]]
+    ds.feature_names = list(meta["feature_names"])
+    for md in meta["mappers"]:
+        m = BinMapper()
+        m.num_bins = int(md["num_bins"])
+        m.bin_type = int(md["bin_type"])
+        m.missing_type = int(md["missing_type"])
+        m.is_trivial = bool(md["is_trivial"])
+        m.upper_bounds = np.asarray(md["upper_bounds"], np.float64)
+        m.categories = np.asarray(md["categories"], np.int64)
+        m.default_bin = int(md["default_bin"])
+        m.most_freq_bin = int(md["most_freq_bin"])
+        m.missing_bin = int(md["missing_bin"])
+        m.sparse_rate = float(md["sparse_rate"])
+        m.min_value = float(md["min_value"])
+        m.max_value = float(md["max_value"])
+        ds.bin_mappers.append(m)
+    ds.groups = [FeatureGroupInfo([int(i) for i in g["feature_indices"]],
+                                  [int(o) for o in g["bin_offsets"]],
+                                  int(g["num_bins"]))
+                 for g in meta["groups"]]
+    ds.binned = z["binned"]
+    ds.feature_to_group = z["feature_to_group"]
+    ds.feature_group_offset = z["feature_group_offset"]
+    ds.max_bins_per_feature = max((g.num_bins for g in ds.groups), default=1)
+
+    def opt(key):
+        a = z[key]
+        return a if a.size else None
+
+    ds.metadata = Metadata(ds.num_data)
+    ds.metadata.label = opt("label")
+    ds.metadata.weight = opt("weight")
+    ds.metadata.init_score = opt("init_score")
+    qb = opt("query_boundaries")
+    if qb is not None:
+        ds.metadata.query_boundaries = qb.astype(np.int64)
+        qid = np.zeros(ds.num_data, dtype=np.int32)
+        for i in range(len(qb) - 1):
+            qid[qb[i]:qb[i + 1]] = i
+        ds.metadata.query_id = qid
+    ds.monotone_constraints = opt("monotone")
+    ds.feature_penalty = opt("penalty")
+    return ds

@@ -35,7 +35,16 @@ def train(
         params.setdefault("objective", "none")
     early_rounds = params.pop("early_stopping_round", 0)
 
-    booster = Booster(params, train_set)
+    from .utils.timer import global_timer
+    if params.get("machines") or int(params.get("num_machines", 1)) > 1:
+        Log.warning(
+            "machines/num_machines configure the reference's socket/MPI "
+            "cluster; on TPU use jax multi-host instead "
+            "(lightgbm_tpu.parallel.distributed.init_distributed + "
+            "tree_learner=data)")
+
+    with global_timer.timed("dataset construction"):
+        booster = Booster(params, train_set)
     if init_model is not None:
         init = init_model if isinstance(init_model, Booster) else \
             Booster(model_file=init_model)
@@ -86,7 +95,9 @@ def train(
         end = begin + num_boost_round
         while booster.inner.iter_ < end:
             k = min(block, end - booster.inner.iter_)
-            if booster.inner.train_block(k):
+            with global_timer.timed("fused boosting block"):
+                stopped = booster.inner.train_block(k)
+            if stopped:
                 Log.warning("Stopped training because there are no more leaves "
                             "that meet the split requirements")
                 break
@@ -94,14 +105,23 @@ def train(
         booster.inner.best_iteration = booster.best_iteration
         return booster
 
+    snapshot_freq = int(params.get("snapshot_freq", -1))
+    snapshot_base = params.get("output_model") or "model"
+
     for it in range(begin, begin + num_boost_round):
         for cb in callbacks_before:
             cb(CallbackEnv(booster, params, it, begin, begin + num_boost_round, None))
-        stop = booster.update(fobj=fobj)
+        with global_timer.timed("boosting iteration"):
+            stop = booster.update(fobj=fobj)
+        # periodic model snapshots for resume (reference: gbdt.cpp:277
+        # SaveModelToFile(model.snapshot_iter_N) every snapshot_freq iters)
+        if snapshot_freq > 0 and (it + 1) % snapshot_freq == 0:
+            booster.save_model("%s.snapshot_iter_%d" % (snapshot_base, it + 1))
         evals = []
-        if has_train_in_valid:
-            evals.extend(booster.eval_train(feval))
-        evals.extend(booster.eval_valid(feval))
+        with global_timer.timed("metric eval"):
+            if has_train_in_valid:
+                evals.extend(booster.eval_train(feval))
+            evals.extend(booster.eval_valid(feval))
         try:
             for cb in callbacks_after:
                 cb(CallbackEnv(booster, params, it, begin,
@@ -118,6 +138,7 @@ def train(
     if booster.best_iteration < 0:
         booster.best_iteration = booster.inner.iter_
     booster.inner.best_iteration = booster.best_iteration
+    global_timer.maybe_report()
     return booster
 
 

@@ -969,6 +969,7 @@ class SerialTreeLearner:
         if config.monotone_constraints_method == "advanced":
             Log.warning("monotone_constraints_method=advanced is not "
                         "implemented; using intermediate")
+
         self.bins = jnp.asarray(dataset.binned)
         self.num_bin_hist = int(max(2, dataset.group_num_bins().max()
                                     if dataset.num_groups else 2))
@@ -976,6 +977,9 @@ class SerialTreeLearner:
         if dataset.has_bundles:
             self.bundle = {k: jnp.asarray(v)
                            for k, v in dataset.bundle_maps().items()}
+        if self.hp.use_cegb and not self.use_partition():
+            Log.fatal("CEGB penalties require the partitioned builder "
+                      "(max_bin <= 256, tree_builder != dense)")
         self.comm = self._make_comm(comm_axis)
         self._build = jax.jit(self.make_build_fn())
 

@@ -200,11 +200,15 @@ def find_bin(
     m.max_value = float(nonzero.max()) if len(nonzero) else 0.0
 
     n_avail = max_bin - (1 if m.missing_type == MISSING_NAN else 0)
+    forced_inner: List[float] = []
     if forced_bounds is not None and len(forced_bounds) > 0:
-        inner = sorted(float(b) for b in forced_bounds)
-        bounds = [b for b in inner if b < np.inf] + [np.inf]
-        bounds = sorted(set(bounds))
-    else:
+        # forced bounds are INSERTED; the remaining budget still fills with
+        # density bins (reference: DatasetLoader::GetForcedBins + FindBin
+        # with forced_upper_bounds, bin.cpp:325)
+        forced_inner = sorted(float(b) for b in forced_bounds
+                              if np.isfinite(b))
+        n_avail = max(n_avail - len(forced_inner), 2)
+    if True:
         neg = nonzero[nonzero < -K_ZERO_THRESHOLD]
         pos = nonzero[nonzero > K_ZERO_THRESHOLD]
         # split bin budget between negative / zero / positive regions by density
@@ -231,6 +235,8 @@ def find_bin(
         bounds = sorted(set(bounds_list))
         bounds.append(np.inf)
 
+    if forced_inner:
+        bounds = sorted(set(list(bounds) + forced_inner))
     m.upper_bounds = np.asarray(bounds, dtype=np.float64)
     num_value_bins = len(bounds)
     if m.missing_type == MISSING_NAN:

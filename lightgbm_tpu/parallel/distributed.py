@@ -1,0 +1,68 @@
+"""Multi-host training entry (reference analog: the Dask layer,
+python-package/lightgbm/dask.py:56,333, and the CLI's machine-list network
+init, application.cpp:168).
+
+On TPU pods the reference's socket/MPI bootstrap collapses into JAX's
+multi-host runtime: every host runs the same program, calls
+``init_distributed()`` once (jax.distributed.initialize discovers peers
+from the TPU metadata or the explicit coordinator address), and trains with
+``tree_learner=data|voting`` over the GLOBAL device mesh — XLA routes the
+histogram collectives over ICI within a slice and DCN across slices.
+There is no Dask scheduler, no machine list, no open-port probing
+(dask.py:56 _find_open_port): process placement is the platform's job.
+
+Typical pod usage::
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.parallel.distributed import init_distributed, global_mesh
+
+    init_distributed()                       # once per host process
+    with global_mesh():
+        bst = lgb.train({"tree_learner": "data", ...}, dset)
+
+Every host must construct the same Dataset (pre-sharding rows by host is
+unnecessary: the mesh shards rows across all global devices).
+"""
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import Optional
+
+import jax
+
+from ..utils.log import Log
+from .mesh import make_mesh
+
+
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> None:
+    """Initialize the JAX multi-host runtime (idempotent).
+
+    With no arguments, platform auto-detection applies (TPU pod metadata /
+    cloud environment variables) — the analog of the reference reading
+    ``machines``/``num_machines`` (config.h) before Network::Init.
+    """
+    if jax.process_count() > 1 or getattr(init_distributed, "_done", False):
+        return
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id)
+        init_distributed._done = True
+        Log.info("distributed: process %d of %d, %d global devices",
+                 jax.process_index(), jax.process_count(),
+                 len(jax.devices()))
+    except Exception as e:
+        Log.warning("jax.distributed.initialize failed (%s); continuing "
+                    "single-host with %d local devices", e,
+                    len(jax.local_devices()))
+
+
+@contextmanager
+def global_mesh(n_devices: Optional[int] = None):
+    """A 1-D data mesh over ALL global devices (multi-host aware)."""
+    mesh = make_mesh(n_devices)
+    with mesh:
+        yield mesh

@@ -300,10 +300,14 @@ class Tree:
         return out
 
     def apply_shrinkage(self, rate: float) -> None:
-        """(reference: tree.h:187 Shrinkage)"""
+        """(reference: tree.h:187 Shrinkage — scales linear leaves too)"""
         self.leaf_value *= rate
         self.internal_value *= rate
         self.shrinkage *= rate
+        if self.is_linear:
+            self.leaf_const *= rate
+            for l in self.leaf_coeff:
+                self.leaf_coeff[l] = self.leaf_coeff[l] * rate
 
     def add_bias(self, val: float) -> None:
         self.leaf_value += val

@@ -36,6 +36,19 @@ class Timer:
         self._acc[name] += seconds
         self._cnt[name] += 1
 
+    def maybe_report(self) -> None:
+        """Log the accumulated report when profiling is requested
+        (LIGHTGBM_TPU_TIMETAG=1 — the reference's USE_TIMETAG analog) or at
+        debug verbosity."""
+        import os as _os
+        from .log import Log as _Log
+        if _os.environ.get("LIGHTGBM_TPU_TIMETAG") == "1":
+            for line in self.report().splitlines():
+                _Log.info("%s", line)
+        else:
+            for line in self.report().splitlines():
+                _Log.debug("%s", line)
+
     def report(self) -> str:
         lines = ["LightGBM-TPU phase timers:"]
         for name in sorted(self._acc, key=self._acc.get, reverse=True):

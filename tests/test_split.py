@@ -18,6 +18,7 @@ def _meta(num_bins, nan_missing=None, is_cat=None):
         is_categorical=jnp.asarray(cat),
         monotone=jnp.zeros(f, jnp.int8),
         penalty=jnp.ones(f, jnp.float32),
+        cegb_coupled=jnp.zeros(f, jnp.float32),
     )
 
 
