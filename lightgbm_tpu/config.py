@@ -213,7 +213,10 @@ class Config:
     #   (O(N) masked histograms; required when max_bin > 256)
     tpu_part_chunk: int = 2048       # rows per partition compaction chunk
     tpu_hist_chunk: int = 2048       # rows per segment-histogram chunk
-    tpu_hist_precision: str = "hilo"  # hilo (~2^-17 rel, bf16 pair) | bf16
+    tpu_hist_precision: str = "hilo"  # hilo (~2^-17 rel, bf16 pair) |
+    #   bf16 (single bf16 grads) | int8 (quantized training)
+    use_quantized_grad: bool = False  # int8 stochastic gradient quantization
+    #   (LightGBM 4.x quantized training analog; rows per leaf <= ~16M)
 
     # resolved, not user-set
     num_original_features: int = 0

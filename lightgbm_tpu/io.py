@@ -71,15 +71,30 @@ def load_text_file(
         skip = 1
 
     if fmt == "libsvm":
-        X, label = _load_libsvm(filename, skip)
+        from .io_native import parse_file
+        parsed = None if skip else parse_file(filename, expect_fmt="libsvm")
+        if parsed is not None:
+            M = parsed[0]
+            label, X = M[:, 0], M[:, 1:]
+        else:
+            X, label = _load_libsvm(filename, skip)
         weight = None
         feature_names = None
         label_idx = -1
         used_cols = None
     else:
         sep = "," if fmt == "csv" else "\t"
-        raw = np.genfromtxt(filename, delimiter=sep, skip_header=skip,
-                            dtype=np.float64)
+        raw = None
+        if not skip:
+            # native parser (native/parser.cpp via ctypes) — the reference's
+            # C++ Parser/fast_double_parser analog
+            from .io_native import parse_file
+            parsed = parse_file(filename, expect_fmt=fmt)
+            if parsed is not None:
+                raw = parsed[0]
+        if raw is None:
+            raw = np.genfromtxt(filename, delimiter=sep, skip_header=skip,
+                                dtype=np.float64)
         if raw.ndim == 1:
             raw = raw.reshape(-1, 1)
         ncol = raw.shape[1]

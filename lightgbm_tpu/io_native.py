@@ -1,0 +1,113 @@
+"""ctypes binding for the native text parser (native/parser.cpp).
+
+The shared library builds on first use with the baked-in g++ (pybind11 is
+not available in this image; the flat C ABI + ctypes mirrors how the
+reference's python package binds its C API, basic.py ctypes). io.py falls
+back to the pure-Python parser when no compiler is present.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+from typing import Optional, Tuple
+
+import numpy as np
+
+from .utils.log import Log
+
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+
+def _build_lib() -> Optional[str]:
+    src = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "native", "parser.cpp")
+    # per-user cache dir (a fixed world-writable /tmp path would allow
+    # another local user to plant a library) + atomic rename so concurrent
+    # builders never dlopen a half-written file
+    out_dir = os.environ.get("LIGHTGBM_TPU_CACHE") or os.path.join(
+        os.path.expanduser("~"), ".cache", "lightgbm_tpu")
+    os.makedirs(out_dir, exist_ok=True)
+    out = os.path.join(out_dir, "libparser.so")
+    if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
+        return out
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=out_dir)
+    os.close(fd)
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++14", "-o", tmp, src]
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        Log.debug("native parser build unavailable: %s", e)
+        return None
+    if r.returncode != 0:
+        Log.warning("native parser build failed; using the Python parser:\n%s",
+                    r.stderr[-500:])
+        os.unlink(tmp)
+        return None
+    os.replace(tmp, out)
+    return out
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    path = _build_lib()
+    if path is None:
+        return None
+    lib = ctypes.CDLL(path)
+    lib.count_dims.argtypes = [ctypes.c_char_p, ctypes.POINTER(ctypes.c_int),
+                               ctypes.POINTER(ctypes.c_int64),
+                               ctypes.POINTER(ctypes.c_int64)]
+    lib.count_dims.restype = ctypes.c_int
+    dptr = np.ctypeslib.ndpointer(dtype=np.float64, flags="C_CONTIGUOUS")
+    lib.parse_dense.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                ctypes.c_int64, ctypes.c_int64, dptr]
+    lib.parse_dense.restype = ctypes.c_int
+    lib.parse_libsvm.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                 ctypes.c_int64, dptr]
+    lib.parse_libsvm.restype = ctypes.c_int
+    _LIB = lib
+    return lib
+
+
+def parse_file(path: str,
+               expect_fmt: Optional[str] = None
+               ) -> Optional[Tuple[np.ndarray, str]]:
+    """Parse a CSV/TSV/LibSVM file natively.
+
+    Returns (matrix, fmt) where matrix column 0 is the raw first column
+    (the caller applies label/ignore-column semantics), fmt in
+    {"csv", "tsv", "space", "libsvm"} — or None when the native path is
+    unavailable or the detected format differs from ``expect_fmt``
+    (caller falls back to Python).
+    """
+    lib = get_lib()
+    if lib is None:
+        return None
+    sep = ctypes.c_int(0)
+    rows = ctypes.c_int64(0)
+    cols = ctypes.c_int64(0)
+    if lib.count_dims(path.encode(), ctypes.byref(sep), ctypes.byref(rows),
+                      ctypes.byref(cols)) != 0:
+        return None
+    n, c = int(rows.value), int(cols.value)
+    if n == 0 or c == 0:
+        return None
+    detected = "libsvm" if sep.value == -1 else \
+        {",": "csv", "\t": "tsv"}.get(chr(sep.value), "space")
+    if expect_fmt is not None and detected != expect_fmt:
+        return None
+    out = np.empty((n, c), dtype=np.float64)
+    if sep.value == -1:
+        rc = lib.parse_libsvm(path.encode(), n, c, out)
+        fmt = "libsvm"
+    else:
+        rc = lib.parse_dense(path.encode(), sep.value, n, c, out)
+        fmt = {",": "csv", "\t": "tsv"}.get(chr(sep.value), "space")
+    if rc != 0:
+        return None
+    return out, fmt

@@ -444,7 +444,7 @@ def build_tree_partitioned(
     comm: Comm = Comm(),
     hist_chunk: int = 2048,
     part_chunk: int = 2048,
-    hist_exact: bool = True,
+    hist_mode: str = "hilo",  # hilo (bf16-pair) | bf16 | int8 (quantized)
     num_bin_hist: Optional[int] = None,   # bundled-column bins (defaults num_bin)
     bundle: Optional[dict] = None,        # EFB maps (dataset.bundle_maps)
     constraint_sets: Optional[jax.Array] = None,   # (S, F) bool
@@ -465,8 +465,9 @@ def build_tree_partitioned(
     Same in/out contract as ``build_tree``; runs identically single-device
     or under shard_map (all collectives go through ``comm``).
     """
-    from .ops.histogram import hist16_segment
-    from .ops.partition import pack_rows, partition_segment
+    from .ops.histogram import hist16_segment, hist16_segment_q
+    from .ops.partition import (pack_rows, pack_rows_quantized,
+                                partition_segment)
 
     n, num_grp = bins.shape
     num_feat = int(meta.num_bins.shape[0])
@@ -474,17 +475,32 @@ def build_tree_partitioned(
     n_forced = 0 if forced is None else int(forced[0].shape[0])
     guard = max(part_chunk, hist_chunk)
     bm = num_bin_hist if num_bin_hist is not None else num_bin
+    quantized = hist_mode == "int8"
 
     # ---- packed ping-pong working buffers with guard rows ----
     # the matrix columns are EFB bundles (== features when no bundling)
     pad = ((guard, guard), (0, 0))
-    work0 = pack_rows(jnp.pad(bins, pad), jnp.pad(ghc, pad))
-    work = jnp.stack([work0, jnp.zeros_like(work0)])     # (2, Npad, G+12)
+    if quantized:
+        # per-tree local quantization scales; histograms dequantize before
+        # any collective, so shards may scale independently
+        gscale = 127.0 / (jnp.max(jnp.abs(ghc[:, 0])) + 1e-12)
+        hscale = 127.0 / (jnp.max(jnp.abs(ghc[:, 1])) + 1e-12)
+        work0 = pack_rows_quantized(
+            jnp.pad(bins, pad), jnp.pad(ghc, pad),
+            jax.random.fold_in(key, 987123), gscale, hscale)
+    else:
+        work0 = pack_rows(jnp.pad(bins, pad), jnp.pad(ghc, pad))
+    work = jnp.stack([work0, jnp.zeros_like(work0)])     # (2, Npad, G+12|3)
 
     def hist_of(work, plane, start, cnt):
-        h = hist16_segment(work, plane, start, cnt, num_bins=bm,
-                           num_feat=num_grp, exact=hist_exact,
-                           chunk=hist_chunk)
+        if quantized:
+            h = hist16_segment_q(work, plane, start, cnt, gscale, hscale,
+                                 num_bins=bm, num_feat=num_grp,
+                                 chunk=hist_chunk)
+        else:
+            h = hist16_segment(work, plane, start, cnt, num_bins=bm,
+                               num_feat=num_grp, exact=hist_mode != "bf16",
+                               chunk=hist_chunk)
         return comm.hist(h)                               # (G, Bm, 3)
 
     def feat_view(hg, total_sum):
@@ -980,6 +996,11 @@ class SerialTreeLearner:
         if self.hp.use_cegb and not self.use_partition():
             Log.fatal("CEGB penalties require the partitioned builder "
                       "(max_bin <= 256, tree_builder != dense)")
+        if (config.use_quantized_grad
+                or config.tpu_hist_precision == "int8") \
+                and not self.use_partition():
+            Log.fatal("use_quantized_grad requires the partitioned builder "
+                      "(max_bin <= 256, tree_builder != dense)")
         self.comm = self._make_comm(comm_axis)
         self._build = jax.jit(self.make_build_fn())
 
@@ -1029,10 +1050,13 @@ class SerialTreeLearner:
             forced=self._forced_splits(),
         )
         if self.use_partition():
+            mode = config.tpu_hist_precision
+            if config.use_quantized_grad:
+                mode = "int8"
             kw.update(
                 hist_chunk=int(config.tpu_hist_chunk),
                 part_chunk=int(config.tpu_part_chunk),
-                hist_exact=config.tpu_hist_precision != "bf16",
+                hist_mode=mode,
                 num_bin_hist=self.num_bin_hist,
                 bundle=self.bundle,
             )

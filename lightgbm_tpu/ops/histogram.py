@@ -182,6 +182,60 @@ def _hist16_combine(acc, num_bins: int, exact: bool):
     return h
 
 
+def _hist16_chunk_int8(cb, gq, hq, cnt, valid, num_bins: int):
+    """int8 quantized chunk: one-hot x int8 dots accumulate in int32 on the
+    MXU at 2x bf16 peak with ~2.5x less operand materialization."""
+    sh = (num_bins + LO_W - 1) // LO_W
+    hi = (cb >> 4).astype(jnp.uint8)
+    lo = (cb & 15).astype(jnp.uint8)
+    hi_oh = (hi[:, :, None] == jnp.arange(sh, dtype=jnp.uint8)) \
+        .astype(jnp.int8)                                    # (C, F, SH)
+    lo_oh = (lo[:, :, None] == jnp.arange(LO_W, dtype=jnp.uint8))
+    v = valid.astype(jnp.int8)
+    ch = jnp.stack([gq.astype(jnp.int8) * v, hq.astype(jnp.int8) * v,
+                    cnt.astype(jnp.int8) * v], axis=1)       # (C, 3)
+    c, f = cb.shape
+    log_ = (lo_oh[:, :, :, None].astype(jnp.int8)
+            * ch[:, None, None, :]).reshape(c, f, LO_W * 3)
+    return jnp.einsum("cfh,cfx->fhx", hi_oh, log_,
+                      preferred_element_type=jnp.int32)
+
+
+def hist16_segment_q(work: jax.Array, plane, start, cnt, gscale, hscale, *,
+                     num_bins: int, num_feat: int,
+                     chunk: int = 2048) -> jax.Array:
+    """int8-quantized segment histogram -> dequantized (F, num_bins, 3) f32.
+
+    work rows are (F + 3) u8: bins then int8 g, int8 h, u8 cnt
+    (ops/partition.py pack_rows_quantized). int32 accumulation bounds rows
+    at ~16M per leaf (127 * N < 2^31).
+    """
+    from .partition import unpack_ghq
+
+    f = num_feat
+    sh = (num_bins + LO_W - 1) // LO_W
+    nchunks = (cnt + chunk - 1) // chunk
+    width = work.shape[2]
+
+    def body(i, acc):
+        off = start + i * chunk
+        cw = jax.lax.dynamic_slice(work, (plane, off, 0),
+                                   (1, chunk, width))[0]
+        cb = cw[:, :f]
+        gq, hq, cq = unpack_ghq(cw, f)
+        rows_left = cnt - i * chunk
+        valid = jnp.arange(chunk, dtype=jnp.int32) < rows_left
+        return acc + _hist16_chunk_int8(cb, gq, hq, cq, valid, num_bins)
+
+    acc = jax.lax.fori_loop(
+        0, nchunks, body,
+        jnp.zeros((f, sh, LO_W * 3), jnp.int32))
+    h = acc.reshape(f, sh, LO_W, 3).reshape(f, sh * LO_W, 3)[:, :num_bins]
+    scale = jnp.stack([1.0 / gscale, 1.0 / hscale,
+                       jnp.float32(1.0)])
+    return h.astype(jnp.float32) * scale[None, None, :]
+
+
 def hist16_segment(work: jax.Array, plane, start, cnt, *,
                    num_bins: int, num_feat: int, exact: bool = True,
                    chunk: int = 2048) -> jax.Array:

@@ -99,3 +99,35 @@ def test_phase_timers(rng):
               valid_sets=[lgb.Dataset(X[:100], label=y[:100])])
     rep = global_timer.report()
     assert "boosting iteration" in rep and "dataset construction" in rep
+
+
+def test_native_parser_matches_python(tmp_path, rng):
+    """native/parser.cpp via ctypes vs numpy (reference: src/io/parser.cpp
+    + fast_double_parser). Skips when no compiler is available."""
+    from lightgbm_tpu.io_native import parse_file
+
+    X = rng.randn(500, 7)
+    p = str(tmp_path / "t.tsv")
+    np.savetxt(p, X, delimiter="\t", fmt="%.6g")
+    out = parse_file(p)
+    if out is None:
+        pytest.skip("native parser unavailable (no g++)")
+    M, fmt = out
+    assert fmt == "tsv"
+    np.testing.assert_allclose(M, np.genfromtxt(p, delimiter="\t"))
+
+
+def test_quantized_gradients_accuracy(rng):
+    """int8 quantized-gradient histograms (LightGBM 4.x quantized training
+    analog) must track the exact path's accuracy."""
+    n = 20000
+    X = rng.randn(n, 10)
+    y = (X @ rng.randn(10) + 0.3 * rng.randn(n) > 0).astype(np.float64)
+    base = {"objective": "binary", "num_leaves": 31, "verbosity": -1,
+            "metric": ["auc"]}
+    exact = lgb.train(dict(base), lgb.Dataset(X, label=y), num_boost_round=10)
+    quant = lgb.train(dict(base, use_quantized_grad=True),
+                      lgb.Dataset(X, label=y), num_boost_round=10)
+    (_, _, auc_e, _), = exact.eval_train()
+    (_, _, auc_q, _), = quant.eval_train()
+    assert auc_q > auc_e - 0.01
