@@ -169,7 +169,11 @@ class GBDT:
         cache = getattr(self, "_tree_log_cache", None)
         if cache is None:
             cache = self._tree_log_cache = {}
-        key = (id(tree), tree.leaf_value.tobytes(), id(ds))
+        # content key (not id()): a GC'd tree's address can be reused by a
+        # new tree with byte-identical leaf values after rollback
+        key = (tree.num_leaves, tree.split_feature.tobytes(),
+               tree.threshold.tobytes(), tree.decision_type.tobytes(),
+               tree.leaf_value.tobytes(), id(ds))
         log = cache.get(key)
         if log is None:
             if len(cache) > 4096:
@@ -188,6 +192,10 @@ class GBDT:
             from .ops.binning import BIN_CATEGORICAL
             hc = any(m.bin_type == BIN_CATEGORICAL for m in ds.bin_mappers)
         leaf = assign_leaves(bins, log, has_categorical=hc, bundle=bundle)
+        if leaf.shape[0] != ds.num_data:
+            # mesh learners pad rows to a multiple of the device count; the
+            # score buffers are unpadded (num_data) — truncate before use
+            leaf = leaf[:ds.num_data]
         return np.asarray(log.leaf_value), leaf
 
     # --------------------------------------------------------------- sampling

@@ -26,6 +26,64 @@ import numpy as np
 from .config import Config
 from .learner import SerialTreeLearner, TreeLog, leaf_values_by_row
 
+# Process-wide cache of jitted block functions. A Booster's jitted callables
+# die with the Booster, so back-to-back train() calls with identical
+# config/shape fingerprints (the bench's warmup+timed pair, CV folds, the
+# test suite) would re-pay trace+lower+compile (~20-30 s at 2M rows) per
+# call. All data-dependent arrays are passed as jit ARGUMENTS (never closure
+# constants), so a fingerprint hit is safe across Booster instances: the
+# cached trace reads its array state from the call's operands.
+_BLOCK_CACHE: dict = {}
+_BLOCK_CACHE_MAX = 64
+
+
+def _fp_hash(x) -> str:
+    import hashlib
+    h = hashlib.sha1()
+    if isinstance(x, np.ndarray):
+        h.update(str(x.dtype).encode()); h.update(str(x.shape).encode())
+        h.update(np.ascontiguousarray(x).tobytes())
+    elif isinstance(x, jax.Array):
+        return _fp_hash(np.asarray(x))
+    elif isinstance(x, (list, tuple)):
+        for v in x:
+            h.update(_fp_hash(v).encode())
+    elif isinstance(x, dict):
+        for k in sorted(x):
+            h.update(str(k).encode()); h.update(_fp_hash(x[k]).encode())
+    else:
+        h.update(repr(x).encode())
+    return h.hexdigest()
+
+
+def _config_fp(cfg: Config) -> str:
+    import dataclasses
+    items = []
+    for f in dataclasses.fields(cfg):
+        v = getattr(cfg, f.name)
+        if isinstance(v, (list, dict)):
+            v = repr(v)
+        items.append((f.name, v))
+    return _fp_hash(items)
+
+
+def _obj_array_state(obj) -> dict:
+    """The objective's jax.Array attributes, to be passed as jit operands."""
+    return {k: v for k, v in vars(obj).items() if isinstance(v, jax.Array)}
+
+
+def _obj_static_fp(obj) -> str:
+    """Fingerprint of everything on the objective that is NOT passed as an
+    operand (python scalars, np arrays — these embed in the trace)."""
+    items = []
+    for k in sorted(vars(obj)):
+        v = getattr(obj, k)
+        if isinstance(v, jax.Array):
+            items.append((k, "arr", str(v.shape), str(v.dtype)))
+        else:
+            items.append((k, _fp_hash(v)))
+    return _fp_hash([type(obj).__name__, items])
+
 
 class BlockLogs(NamedTuple):
     """Stacked per-tree split logs for one fused block: (k, T_per_iter, ...)"""
@@ -114,33 +172,48 @@ class FusedTrainer:
         self.gbdt = gbdt
         self.learner: SerialTreeLearner = gbdt.learner
         self.config: Config = gbdt.config
-        self._fns = {}
         cfg = self.config
-        n = gbdt.train_set.num_data
-        if (cfg.pos_bagging_fraction < 1.0 or cfg.neg_bagging_fraction < 1.0) \
-                and cfg.bagging_freq > 0 and gbdt.objective.label is not None:
-            self.sampler = make_balanced_sampler(cfg, gbdt.objective.label)
-        else:
-            self.sampler = make_sampler(cfg, n)
+        self._balanced = bool(
+            (cfg.pos_bagging_fraction < 1.0 or cfg.neg_bagging_fraction < 1.0)
+            and cfg.bagging_freq > 0 and gbdt.objective.label is not None)
         self.num_feat = gbdt.train_set.num_features
 
+    def _fingerprint(self, k: int) -> tuple:
+        """Everything that shapes the traced block computation but is not a
+        jit operand: the resolved config, the objective's static state, the
+        learner's closed-over arrays (EFB bundle, forced splits, interaction
+        constraints), and the operand shape signature."""
+        g = self.gbdt
+        lrn = self.learner
+        bins = lrn.bins
+        return (
+            k, g.num_tree_per_iteration, type(lrn).__name__,
+            _config_fp(g.config), _obj_static_fp(g.objective),
+            str(bins.shape), str(bins.dtype), str(g.train_score.score.shape),
+            lrn.num_bin_hist,
+            (lrn.comm.axis, lrn.comm.mode, lrn.comm.top_k,
+             lrn.comm.num_machines),
+            _fp_hash(lrn.bundle), _fp_hash(lrn._forced_splits()),
+            _fp_hash(lrn._constraint_sets()),
+        )
+
     def _block_fn(self, k: int):
-        if k in self._fns:
-            return self._fns[k]
+        fp = self._fingerprint(k)
+        fn = _BLOCK_CACHE.get(fp)
+        if fn is not None:
+            return fn
         gbdt = self.gbdt
         learner = self.learner
         cfg = self.config
         obj = gbdt.objective
         K = gbdt.num_tree_per_iteration
         lr = float(cfg.learning_rate)
-        sampler = self.sampler
+        balanced = self._balanced
         nf = self.num_feat
         ffrac = float(cfg.feature_fraction)
-        bins = learner.bins
-        meta = learner.meta
         build = learner.make_build_fn()
 
-        def one_iter(score, cegb_used, key, it):
+        def one_iter(sampler, bins, meta, score, cegb_used, key, it):
             if obj.needs_iter:
                 g, h = obj.get_gradients(score, it)
             else:
@@ -183,14 +256,33 @@ class FusedTrainer:
             return score, cegb_used, stacked
 
         @jax.jit
-        def run_block(score, cegb_used, key, it0):
-            def body(carry, i):
-                score, used = carry
-                score, used, stacked = one_iter(score, used, key, it0 + i)
-                return (score, used), stacked
-            return jax.lax.scan(body, (score, cegb_used), jnp.arange(k))
+        def run_block(score, cegb_used, key, it0, bins, meta, ostate):
+            # Array state rides in as operands; swap it onto the objective
+            # for the duration of the trace so nothing N-sized embeds in the
+            # program (embedded constants made lowering + compile-cache
+            # serialization scale with the dataset: ~30 s/call at 2M rows).
+            saved = {a: getattr(obj, a) for a in ostate}
+            for a, v in ostate.items():
+                setattr(obj, a, v)
+            try:
+                if balanced:
+                    sampler = make_balanced_sampler(cfg, obj.label)
+                else:
+                    sampler = make_sampler(cfg, score.shape[0])
 
-        self._fns[k] = run_block
+                def body(carry, i):
+                    score, used = carry
+                    score, used, stacked = one_iter(
+                        sampler, bins, meta, score, used, key, it0 + i)
+                    return (score, used), stacked
+                return jax.lax.scan(body, (score, cegb_used), jnp.arange(k))
+            finally:
+                for a, v in saved.items():
+                    setattr(obj, a, v)
+
+        if len(_BLOCK_CACHE) >= _BLOCK_CACHE_MAX:
+            _BLOCK_CACHE.clear()
+        _BLOCK_CACHE[fp] = run_block
         return run_block
 
     def run(self, k: int) -> bool:
@@ -207,7 +299,9 @@ class FusedTrainer:
         import jax.numpy as _jnp
         (score, used), logs = fn(gbdt.train_score.score,
                                  _jnp.asarray(gbdt._cegb_used),
-                                 gbdt._key, jnp.int32(it0))
+                                 gbdt._key, jnp.int32(it0),
+                                 self.learner.bins, self.learner.meta,
+                                 _obj_array_state(gbdt.objective))
         gbdt.train_score.score = score
         gbdt._cegb_used = np.asarray(used)
         host = jax.device_get(logs)

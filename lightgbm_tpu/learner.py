@@ -666,9 +666,14 @@ def build_tree_partitioned(
             def pick_forced(_):
                 ri = jnp.minimum(r, n_forced - 1)
                 fl = f_leaf[ri]
+                # voting keeps hist_pool LOCAL; a forced split must still be
+                # identical on every shard (default_left/gain derive from
+                # missing mass), so globalize the leaf histogram first. The
+                # cond predicate is replicated, so the psum is uniform.
+                hg_forced = comm.psum(hist_pool[fl]) if voting \
+                    else hist_pool[fl]
                 fi = find_best_split(
-                    feat_view(hist_pool[fl],
-                              leaf_sum_loc[fl] if voting else leaf_sum[fl]),
+                    feat_view(hg_forced, leaf_sum[fl]),
                     leaf_sum[fl], meta,
                     jnp.arange(num_feat) == f_feat[ri], hp,
                     parent_output=leaf_out[fl], leaf_lower=leaf_lower[fl],
@@ -978,9 +983,11 @@ class SerialTreeLearner:
             in ("intermediate", "advanced"),
             cegb_tradeoff=float(config.cegb_tradeoff),
             cegb_penalty_split=float(config.cegb_penalty_split),
+            # gate on an actually non-zero penalty: cegb_tradeoff alone is a
+            # multiplier with nothing to multiply, and enabling CEGB forces
+            # the partitioned builder for runs that would train identically
             use_cegb=bool(config.cegb_penalty_split > 0
-                          or config.cegb_penalty_feature_coupled
-                          or config.cegb_tradeoff < 1.0),
+                          or config.cegb_penalty_feature_coupled),
         )
         if config.monotone_constraints_method == "advanced":
             Log.warning("monotone_constraints_method=advanced is not "

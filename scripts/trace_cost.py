@@ -1,0 +1,33 @@
+"""Measure trace/lower/compile cost of the fused training block at bench shape."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+import lightgbm_tpu as lgb
+from bench import make_higgs_like
+
+N = int(os.environ.get("PROF_N", 2_000_000))
+X, y = make_higgs_like(N)
+params = {
+    "objective": "binary", "num_leaves": 255, "max_bin": 255,
+    "learning_rate": 0.1, "verbosity": -1, "tpu_iter_block": 20,
+}
+
+t0 = time.time()
+ds = lgb.Dataset(X, label=y)
+ds.construct()
+print(f"dataset construct: {time.time()-t0:.1f}s")
+
+for rep in range(3):
+    t0 = time.time()
+    bst = lgb.train(dict(params), ds, num_boost_round=20)
+    print(f"train#{rep} 20 iters: {time.time()-t0:.1f}s")
