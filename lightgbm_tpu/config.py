@@ -211,7 +211,10 @@ class Config:
     tree_builder: str = "auto"       # auto|partition|dense: partitioned
     #   leaf-contiguous builder (O(child) histograms) vs round-1 dense
     #   (O(N) masked histograms; required when max_bin > 256)
-    tpu_part_chunk: int = 2048       # rows per partition compaction chunk
+    tpu_part_chunk: int = 0          # rows per partition compaction chunk
+    #   (0 = auto: 1024 for the fused pallas kernel, 2048 for the XLA path)
+    tpu_partition_kernel: str = "auto"  # auto|pallas|xla: fused Pallas DMA
+    #   partition kernel (TPU only) vs the portable XLA op pipeline
     tpu_hist_chunk: int = 2048       # rows per segment-histogram chunk
     tpu_hist_precision: str = "hilo"  # hilo (~2^-17 rel, bf16 pair) |
     #   bf16 (single bf16 grads) | int8 (quantized training)

@@ -212,8 +212,9 @@ class FusedTrainer:
         nf = self.num_feat
         ffrac = float(cfg.feature_fraction)
         build = learner.make_build_fn()
+        wspec = learner.work_buf_spec()
 
-        def one_iter(sampler, bins, meta, score, cegb_used, key, it):
+        def one_iter(sampler, bins, meta, score, cegb_used, wbuf, key, it):
             if obj.needs_iter:
                 g, h = obj.get_gradients(score, it)
             else:
@@ -239,8 +240,15 @@ class FusedTrainer:
                 else:
                     cnt = jnp.ones_like(gc)
                 ghc = jnp.stack([gc, hc, cnt], axis=1)
-                log = build(bins, ghc, meta, fmask,
-                            jax.random.fold_in(key, it * 131 + c), cegb_used)
+                if wspec is not None:
+                    log, wbuf = build(
+                        bins, ghc, meta, fmask,
+                        jax.random.fold_in(key, it * 131 + c), cegb_used,
+                        work_buf=wbuf, return_work=True)
+                else:
+                    log = build(bins, ghc, meta, fmask,
+                                jax.random.fold_in(key, it * 131 + c),
+                                cegb_used)
                 valid_r = jnp.arange(log.feature.shape[0]) < log.num_splits
                 cegb_used = cegb_used.at[
                     jnp.where(valid_r, log.feature, nf)].set(True, mode="drop")
@@ -253,7 +261,7 @@ class FusedTrainer:
                     score = score + upd
                 logs.append(_small(log))
             stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *logs) if K > 1 else logs[0]
-            return score, cegb_used, stacked
+            return score, cegb_used, wbuf, stacked
 
         @jax.jit
         def run_block(score, cegb_used, key, it0, bins, meta, ostate):
@@ -269,13 +277,20 @@ class FusedTrainer:
                     sampler = make_balanced_sampler(cfg, obj.label)
                 else:
                     sampler = make_sampler(cfg, score.shape[0])
+                # one ping-pong work buffer allocated per block and carried
+                # across the k trees (a fresh 2x(N, W) alloc+zero per tree
+                # costs ~260 MB of HBM writes at 2M rows)
+                wbuf = jnp.zeros(wspec[0], wspec[1]) \
+                    if wspec is not None else jnp.zeros((), jnp.uint8)
 
                 def body(carry, i):
-                    score, used = carry
-                    score, used, stacked = one_iter(
-                        sampler, bins, meta, score, used, key, it0 + i)
-                    return (score, used), stacked
-                return jax.lax.scan(body, (score, cegb_used), jnp.arange(k))
+                    score, used, wbuf = carry
+                    score, used, wbuf, stacked = one_iter(
+                        sampler, bins, meta, score, used, wbuf, key, it0 + i)
+                    return (score, used, wbuf), stacked
+                (score, used, _), stacked = jax.lax.scan(
+                    body, (score, cegb_used, wbuf), jnp.arange(k))
+                return (score, used), stacked
             finally:
                 for a, v in saved.items():
                     setattr(obj, a, v)

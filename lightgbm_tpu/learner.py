@@ -449,6 +449,9 @@ def build_tree_partitioned(
     bundle: Optional[dict] = None,        # EFB maps (dataset.bundle_maps)
     constraint_sets: Optional[jax.Array] = None,   # (S, F) bool
     forced: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None,
+    part_kernel: str = "xla",  # xla | pallas (fused DMA kernel, TPU only)
+    work_buf: Optional[jax.Array] = None,  # carried (2, Npad, W) u8 buffer
+    return_work: bool = False,
 ) -> TreeLog:
     """Grow one leaf-wise tree with a physical row partition.
 
@@ -467,15 +470,18 @@ def build_tree_partitioned(
     """
     from .ops.histogram import hist16_segment, hist16_segment_q
     from .ops.partition import (pack_rows, pack_rows_quantized,
-                                partition_segment)
+                                partition_segment, partition_segment_fused)
 
     n, num_grp = bins.shape
     num_feat = int(meta.num_bins.shape[0])
     max_splits = num_leaves - 1
     n_forced = 0 if forced is None else int(forced[0].shape[0])
-    guard = max(part_chunk, hist_chunk)
-    bm = num_bin_hist if num_bin_hist is not None else num_bin
+    fused_part = part_kernel == "pallas"
     quantized = hist_mode == "int8"
+    from .ops.partition import work_spec
+    guard, buf_width = work_spec(num_grp, quantized, part_kernel,
+                                 part_chunk, hist_chunk)
+    bm = num_bin_hist if num_bin_hist is not None else num_bin
 
     # ---- packed ping-pong working buffers with guard rows ----
     # the matrix columns are EFB bundles (== features when no bundling)
@@ -490,7 +496,17 @@ def build_tree_partitioned(
             jax.random.fold_in(key, 987123), gscale, hscale)
     else:
         work0 = pack_rows(jnp.pad(bins, pad), jnp.pad(ghc, pad))
-    work = jnp.stack([work0, jnp.zeros_like(work0)])     # (2, Npad, G+12|3)
+    if work0.shape[1] < buf_width:
+        # the fused kernel DMAs whole 128-lane tiles; pad row width
+        work0 = jnp.pad(work0, ((0, 0), (0, buf_width - work0.shape[1])))
+    if work_buf is not None:
+        # reuse the caller's ping-pong pair (fused blocks carry it across
+        # trees): only plane 0 needs writing — stale plane-1 bytes are never
+        # read before being overwritten (blends commit only valid rows)
+        work = work_buf.at[0].set(work0)
+    else:
+        work = jnp.stack([work0, jnp.zeros_like(work0)])  # (2, Npad, W)
+    part_fn = partition_segment_fused if fused_part else partition_segment
 
     def hist_of(work, plane, start, cnt):
         if quantized:
@@ -640,6 +656,9 @@ def build_tree_partitioned(
             return jnp.bool_(True)
         return depth < max_depth
 
+    node_best_pair = jax.vmap(
+        node_best, in_axes=(None, 0, 0, 0, 0, 0, 0, 0, None, None))
+
     force_live = jnp.bool_(n_forced > 0)
     carry0 = (jnp.int32(0), work, leaf_start, leaf_cnt, leaf_parity,
               hist_pool, leaf_sum, leaf_sum_loc, leaf_out, leaf_depth,
@@ -688,13 +707,26 @@ def build_tree_partitioned(
             leaf, info, force_live = jax.lax.cond(
                 use_forced, pick_forced,
                 lambda _: (leaf, info, jnp.bool_(False)), operand=None)
-        valid = info.gain > -jnp.inf
         s = log.num_splits
         new_leaf = s + 1
 
-        def sel(a, b):
-            """Commit only when the round produced a valid split."""
-            return jnp.where(valid, a, b)
+        if n_forced:
+            valid = info.gain > -jnp.inf
+
+            def sel(a, b):
+                """Commit only when the round produced a valid split."""
+                return jnp.where(valid, a, b)
+        else:
+            # Without forced splits the loop cond guarantees the picked
+            # leaf's gain is positive, so every round commits. Skipping the
+            # where() means no update reads the OLD pool value after the
+            # write — without this, XLA cannot prove the dynamic-update-
+            # slices on the 22 MB hist_pool in-place and inserts two full
+            # copies per split (~72 ms/tree at 255 leaves, profiled).
+            valid = jnp.bool_(True)
+
+            def sel(a, b):
+                return a
 
         # ---- physical partition of the parent's segment ----
         # (invalid rounds write garbage into dead regions of the other
@@ -704,8 +736,8 @@ def build_tree_partitioned(
         parity = leaf_parity[leaf]
         split_col = bundle["group"][info.feature] if bundle is not None \
             else info.feature
-        work, lt = partition_segment(work, parity, start, cnt, split_col,
-                                     route_table(info), ch=part_chunk)
+        work, lt = part_fn(work, parity, start, cnt, split_col,
+                           route_table(info), ch=part_chunk)
         new_parity = 1 - parity
 
         # ---- record ----
@@ -793,34 +825,37 @@ def build_tree_partitioned(
         tree_used = tree_used.at[info.feature].set(
             sel(jnp.bool_(True), tree_used[info.feature]))
 
-        info_l = node_best(r, leaf, hist_left, info.left_sum, loc_left,
-                           leaf_out[leaf], leaf_lower[leaf],
-                           leaf_upper[leaf], used_new, tree_used)
-        info_r = node_best(r, new_leaf, hist_right, info.right_sum, loc_right,
-                           leaf_out[new_leaf], leaf_lower[new_leaf],
-                           leaf_upper[new_leaf], used_new, tree_used)
-        gate_l = depth_ok(leaf_depth[leaf]) & valid
-        gate_r = depth_ok(leaf_depth[new_leaf]) & valid
-        info_l = info_l._replace(gain=jnp.where(gate_l, info_l.gain, -jnp.inf))
-        info_r = info_r._replace(gain=jnp.where(gate_r, info_r.gain, -jnp.inf))
-        old_l = jax.tree.map(lambda a: a[leaf], best)
-        old_r = jax.tree.map(lambda a: a[new_leaf], best)
-        best = _set_best(best, leaf,
-                         jax.tree.map(sel, info_l, old_l))
-        best = _set_best(best, new_leaf,
-                         jax.tree.map(sel, info_r, old_r))
+        # one vmapped search over both children: the scan ops are tiny at
+        # (F, B), so two separate calls pay the per-op dispatch cost twice
+        pair = jnp.stack([leaf, new_leaf])
+        infos = node_best_pair(
+            r, pair, jnp.stack([hist_left, hist_right]),
+            jnp.stack([info.left_sum, info.right_sum]),
+            jnp.stack([loc_left, loc_right]), leaf_out[pair],
+            leaf_lower[pair], leaf_upper[pair], used_new, tree_used)
+        gates = jnp.stack([depth_ok(leaf_depth[leaf]),
+                           depth_ok(leaf_depth[new_leaf])]) & valid
+        infos = infos._replace(gain=jnp.where(gates, infos.gain, -jnp.inf))
+        if n_forced:
+            olds = jax.tree.map(lambda a: a[pair], best)
+            infos = jax.tree.map(
+                lambda a, b: jnp.where(valid, a, b), infos, olds)
+        best = jax.tree.map(lambda b, v: b.at[pair].set(v), best, infos)
 
         return (r + 1, work, leaf_start, leaf_cnt, leaf_parity, hist_pool,
                 leaf_sum, leaf_sum_loc, leaf_out, leaf_depth, leaf_lower,
                 leaf_upper, best, log, leaf_used, tree_used, force_live)
 
     carry = jax.lax.while_loop(cond, body, carry0)
-    (_, _, _, _, _, _, leaf_sum, _, leaf_out, _, _, _, _, log, _, _,
+    (_, work_fin, _, _, _, _, leaf_sum, _, leaf_out, _, _, _, _, log, _, _,
      _) = carry
     row_leaf = assign_leaves(bins, log, has_categorical=hp.has_categorical,
                              bundle=bundle)
-    return log._replace(leaf_value=leaf_out, leaf_sum=leaf_sum,
-                        row_leaf=row_leaf)
+    log = log._replace(leaf_value=leaf_out, leaf_sum=leaf_sum,
+                       row_leaf=row_leaf)
+    if return_work:
+        return log, work_fin
+    return log
 
 
 @partial(jax.jit, static_argnames=("has_categorical",))
@@ -1060,12 +1095,38 @@ class SerialTreeLearner:
             mode = config.tpu_hist_precision
             if config.use_quantized_grad:
                 mode = "int8"
+            part_kernel = config.tpu_partition_kernel
+            auto_kernel = part_kernel == "auto"
+            if auto_kernel:
+                # the fused DMA kernel needs Mosaic; CPU test meshes and
+                # non-TPU backends use the portable XLA pipeline
+                part_kernel = "pallas" if jax.default_backend() in (
+                    "tpu", "axon") else "xla"
+            row_w = self.bins.shape[1] + (3 if mode == "int8" else 12)
+            if part_kernel == "pallas" and row_w > 128:
+                # packed rows no longer fit one 128-lane DMA tile
+                if not auto_kernel:
+                    Log.warning(
+                        "tpu_partition_kernel=pallas needs packed rows "
+                        "<= 128 bytes (got %d); using the XLA kernel",
+                        row_w)
+                part_kernel = "xla"
+            part_chunk = int(config.tpu_part_chunk)
+            if part_chunk <= 0:
+                # measured on v5e: the XLA path optimum is 2048 (per-op
+                # overhead vs O(ch^2) compaction matmul); the pallas kernel
+                # has no per-op overhead, so 1024 halves the matmul work
+                part_chunk = 1024 if part_kernel == "pallas" else 2048
+            if part_kernel == "pallas" and part_chunk % 32:
+                Log.fatal("tpu_part_chunk must be a multiple of 32 for the "
+                          "pallas partition kernel (got %d)", part_chunk)
             kw.update(
                 hist_chunk=int(config.tpu_hist_chunk),
-                part_chunk=int(config.tpu_part_chunk),
+                part_chunk=part_chunk,
                 hist_mode=mode,
                 num_bin_hist=self.num_bin_hist,
                 bundle=self.bundle,
+                part_kernel=part_kernel,
             )
         else:
             kw.update(
@@ -1138,6 +1199,20 @@ class SerialTreeLearner:
             return None
         return (jnp.asarray(leaves, jnp.int32), jnp.asarray(feats, jnp.int32),
                 jnp.asarray(bins_, jnp.int32))
+
+    def work_buf_spec(self):
+        """(shape, dtype) of the carried work buffer for the partitioned
+        builder, or None (fused blocks preallocate it once per block instead
+        of paying a fresh 2x(N,W) alloc+zero per tree)."""
+        if not self.use_partition():
+            return None
+        from .ops.partition import work_spec
+        kw = self.build_kwargs()
+        guard, w = work_spec(self.bins.shape[1],
+                             kw["hist_mode"] == "int8", kw["part_kernel"],
+                             kw["part_chunk"], kw["hist_chunk"])
+        n = self.bins.shape[0]
+        return ((2, n + 2 * guard, w), jnp.uint8)
 
     def train(self, ghc: jax.Array, feature_mask: jax.Array, key: jax.Array,
               cegb_used: Optional[jax.Array] = None) -> TreeLog:

@@ -47,6 +47,12 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
+try:  # pallas is optional at import time (CPU test meshes use the XLA path)
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover
+    pl = pltpu = None
+
 DEFAULT_CH = 2048
 GH_BYTES = 12   # g, h, cnt as f32 bytes
 GH_BYTES_Q = 3  # quantized: g, h as int8 bits, cnt as u8
@@ -105,11 +111,13 @@ def _compact_chunk(cw, go, valid):
     ch = cw.shape[0]
     gl = go & valid
     gr = (~go) & valid
-    nl = jnp.sum(gl.astype(jnp.int32))
-    nr = jnp.sum(gr.astype(jnp.int32))
-    lrank = jnp.cumsum(gl.astype(jnp.int32)) - gl.astype(jnp.int32)
-    rrank = jnp.cumsum(gr.astype(jnp.int32)) - gr.astype(jnp.int32)
-    irank = jnp.cumsum((~valid).astype(jnp.int32)) - (~valid).astype(jnp.int32)
+    # one fused (CH, 3) prefix scan instead of three (profiled: each scan
+    # is a separate ~2 us reduce-window per chunk)
+    flags = jnp.stack([gl, gr, ~valid], axis=1).astype(jnp.int32)
+    ranks = jnp.cumsum(flags, axis=0) - flags
+    lrank, rrank, irank = ranks[:, 0], ranks[:, 1], ranks[:, 2]
+    nl = ranks[-1, 0] + flags[-1, 0]
+    nr = ranks[-1, 1] + flags[-1, 1]
     dest = jnp.where(gl, lrank,
                      jnp.where(gr, ch - nr + rrank, nl + irank))
     # permutation one-hot: P[j, i] = (dest_i == j); compacted = P @ rows.
@@ -177,3 +185,224 @@ def partition_segment(
     work, lcur, _ = jax.lax.fori_loop(
         0, nchunks, body, (work, start, start + cnt))
     return work, lcur - start
+
+
+# ---------------------------------------------------------------------------
+# Fused Pallas kernel: the whole per-split pipeline in one device call
+# ---------------------------------------------------------------------------
+#
+# partition_segment is ~10 XLA ops per chunk; at 2048-row chunks the fixed
+# per-op cost (~19 us/chunk profiled) dominates the actual work (~4 us).
+# A 255-leaf tree partitions ~5.6k chunks, so the op soup costs ~100 ms per
+# tree at 2M rows — the single largest line in the round-2 profile. The
+# Pallas version runs ONE kernel per split: an in-kernel chunk loop with
+# manual HBM<->VMEM DMA, the same route/rank/permute math, and blended
+# read-modify-write stores. Row ranks come from a strict-lower-triangular
+# bf16 matmul (exact: 0/1 operands, f32 accumulation) instead of cumsum,
+# and the compaction stays a permutation matmul on the MXU.
+
+
+ALIGN = 32  # Mosaic requires u8 DMA row offsets provably 32-aligned
+
+
+def work_spec(num_groups: int, quantized: bool, part_kernel: str,
+              part_chunk: int, hist_chunk: int):
+    """(guard_rows, row_width) of the packed ping-pong working buffer.
+
+    Single source of truth shared by the tree builder and the fused
+    trainer's carried-buffer allocation: the fused pallas kernel needs
+    128-lane rows (whole-tile DMA) and guards that cover its aligned
+    write windows reaching up to ALIGN rows past a segment on each side.
+    """
+    width = num_groups + (GH_BYTES_Q if quantized else GH_BYTES)
+    guard = max(part_chunk, hist_chunk)
+    if part_kernel == "pallas":
+        width = 128
+        guard += 2 * ALIGN
+    return guard, width
+
+
+def _partition_kernel(sref, work_in, table_ref, work_ref, lt_ref,
+                      tril, cin, cw2p, lbuf, rbuf, sem, *, ch, width, num_bin):
+    f32 = jnp.float32
+    cho = ch + ALIGN
+    src_plane = sref[0]
+    start = sref[1]
+    cnt = sref[2]
+    feat = sref[3]
+    dst_plane = 1 - src_plane
+    # reads cover [astart, astart + nchunks*ch) with 32-aligned offsets;
+    # the first `head` rows are masked invalid
+    astart = (start // ALIGN) * ALIGN
+    head = start - astart
+    tot = head + cnt
+    nchunks = (tot + ch - 1) // ch
+
+    # strict lower-triangular ones: ranks[i] = sum_{j<i} flags[j].
+    # Arithmetic construction (clamped integer difference) — boolean
+    # (CH, CH) selects hit Mosaic relayout limits on i1 vectors.
+    row_i = jax.lax.broadcasted_iota(jnp.int32, (ch, ch), 0)
+    col_i = jax.lax.broadcasted_iota(jnp.int32, (ch, ch), 1)
+    tril[:] = jnp.clip(row_i - col_i, 0, 1).astype(f32).astype(jnp.bfloat16)
+
+    lane_w = jax.lax.broadcasted_iota(jnp.int32, (ch, width), 1)
+    sub_i = jax.lax.broadcasted_iota(jnp.int32, (ch, 1), 0)
+    sub_o = jax.lax.broadcasted_iota(jnp.int32, (cho, 1), 0)
+
+    def start_in(i, slot):
+        off = astart + i * ch
+        pltpu.make_async_copy(
+            work_in.at[src_plane, pl.ds(off, ch), :], cin.at[slot],
+            sem.at[slot]).start()
+
+    # double-buffered input: chunk i+1 streams in while i computes
+    start_in(0, 0)
+
+    def body(i, carry):
+        lcur, rcur = carry
+        slot = jax.lax.rem(i, 2)
+        pltpu.make_async_copy(
+            work_in.at[src_plane, pl.ds(astart + i * ch, ch), :],
+            cin.at[slot], sem.at[slot]).wait()
+
+        @pl.when(i + 1 < nchunks)
+        def _():
+            start_in(i + 1, 1 - slot)
+
+        # the left read-modify window depends only on lcur: overlap its
+        # read with the routing/compaction compute
+        wl = (lcur // ALIGN) * ALIGN
+        dl = lcur - wl
+        lin = pltpu.make_async_copy(
+            work_in.at[dst_plane, pl.ds(wl, cho), :], lbuf, sem.at[2])
+        lin.start()
+
+        # Mosaic has no direct u8<->f32 casts; bounce through i32
+        cf = cin[slot].astype(jnp.int32).astype(f32)         # (CH, W)
+        col = jnp.sum(jnp.where(lane_w == feat, cf, 0.0), axis=1,
+                      keepdims=True)                         # (CH, 1) f32
+        # routing table lookup as a one-hot contraction over the bin axis
+        bin_l = jax.lax.broadcasted_iota(jnp.int32, (ch, num_bin), 1)
+        oh = (1 - jnp.clip(jnp.abs(bin_l - col.astype(jnp.int32)), 0, 1)) \
+            .astype(f32)
+        go = jnp.sum(oh * table_ref[:], axis=1, keepdims=True) > 0.5
+        pos = sub_i + i * ch
+        valid = (pos >= head) & (pos < tot)                  # (CH, 1)
+        gl = go & valid
+        gr = (~go) & valid
+        flags = jnp.concatenate(
+            [gl.astype(jnp.bfloat16), gr.astype(jnp.bfloat16),
+             (~valid).astype(jnp.bfloat16)], axis=1)         # (CH, 3)
+        ranks = jax.lax.dot(tril[:], flags,
+                            preferred_element_type=f32)      # (CH, 3)
+        nl = jnp.sum(gl.astype(jnp.int32))
+        nr = jnp.sum(gr.astype(jnp.int32))
+        lrank = ranks[:, 0:1].astype(jnp.int32)
+        rrank = ranks[:, 1:2].astype(jnp.int32)
+        irank = ranks[:, 2:3].astype(jnp.int32)
+        dest = jnp.where(gl, lrank,
+                         jnp.where(gr, ch - nr + rrank, nl + irank))  # (CH,1)
+        # permutation one-hot: perm[j, i] = (dest_i == j); compacted = P @ cw
+        destT = dest.reshape(1, ch)
+        perm = (1 - jnp.clip(
+            jnp.abs(jax.lax.broadcasted_iota(jnp.int32, (ch, ch), 0) - destT),
+            0, 1)).astype(f32).astype(jnp.bfloat16)
+        # keep the compacted chunk in f32 (exact byte integers): Mosaic's
+        # dynamic rotate has no i8 form
+        cw2p[0:ch, :] = jax.lax.dot(perm, cf.astype(jnp.bfloat16),
+                                    preferred_element_type=f32)
+
+        # Writes go to 32-aligned windows of CHO = CH + 32 rows; cursor
+        # misalignment is absorbed by a cyclic roll of the compacted chunk,
+        # and blends keep only the landed rows.
+        rolled_l = pltpu.roll(cw2p[:], dl, 0)
+        lin.wait()
+        lb = lbuf[:].astype(jnp.int32).astype(f32)
+        lb = jnp.where((sub_o >= dl) & (sub_o < dl + nl), rolled_l, lb)
+        lbuf[:] = lb.astype(jnp.int32).astype(jnp.uint8)
+        lout = pltpu.make_async_copy(
+            lbuf, work_ref.at[dst_plane, pl.ds(wl, cho), :], sem.at[2])
+        lout.start()
+
+        # right rows sit at [CH-nr, CH) in cw2p; land them at
+        # [rcur-nr, rcur). The left write must complete first: the two
+        # windows overlap when the cursors meet mid-segment.
+        rstart = rcur - nr
+        wr = (rstart // ALIGN) * ALIGN
+        dr = rstart - wr
+        shift_r = jnp.remainder(dr - (ch - nr), cho)
+        rolled_r = pltpu.roll(cw2p[:], shift_r, 0)
+        lout.wait()
+        rin = pltpu.make_async_copy(
+            work_in.at[dst_plane, pl.ds(wr, cho), :], rbuf, sem.at[3])
+        rin.start()
+        rin.wait()
+        rb = rbuf[:].astype(jnp.int32).astype(f32)
+        rb = jnp.where((sub_o >= dr) & (sub_o < dr + nr), rolled_r, rb)
+        rbuf[:] = rb.astype(jnp.int32).astype(jnp.uint8)
+        rout = pltpu.make_async_copy(
+            rbuf, work_ref.at[dst_plane, pl.ds(wr, cho), :], sem.at[3])
+        rout.start()
+        rout.wait()
+        return lcur + nl, rcur - nr
+
+    lcur, _ = jax.lax.fori_loop(0, nchunks, body, (start, start + cnt))
+    lt_ref[0] = lcur - start
+
+
+def partition_segment_fused(
+    work: jax.Array,       # (2, Npad, W) u8 ping-pong buffer pair
+    src_plane: jax.Array,
+    start: jax.Array,
+    cnt: jax.Array,
+    feat: jax.Array,
+    go_left: jax.Array,    # (B,) bool
+    *,
+    ch: int = DEFAULT_CH,
+) -> Tuple[jax.Array, jax.Array]:
+    """Pallas form of :func:`partition_segment` (same contract).
+
+    Requires the work buffer's row width padded to 128 (DMA slices must
+    cover whole 128-lane tiles) and guard regions of at least ch + 32 rows
+    (write windows extend up to 32 rows past the segment on both sides).
+    """
+    num_bin = go_left.shape[0]
+    width = work.shape[2]
+    if width != 128:
+        raise ValueError("fused partition needs width == 128, got %d" % width)
+    scalars = jnp.stack([src_plane.astype(jnp.int32), start.astype(jnp.int32),
+                         cnt.astype(jnp.int32), feat.astype(jnp.int32)])
+    table = go_left.astype(jnp.float32).reshape(1, num_bin)
+
+    kern = partial(_partition_kernel, ch=ch, width=width, num_bin=num_bin)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(1,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.HBM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec(memory_space=pltpu.HBM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((ch, ch), jnp.bfloat16),        # tril
+            pltpu.VMEM((2, ch, width), jnp.uint8),     # cin x2
+            pltpu.VMEM((ch + ALIGN, width), jnp.float32),  # cw2p
+            pltpu.VMEM((ch + ALIGN, width), jnp.uint8),  # lbuf
+            pltpu.VMEM((ch + ALIGN, width), jnp.uint8),  # rbuf
+            pltpu.SemaphoreType.DMA((4,)),
+        ],
+    )
+    work_out, lt = pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct(work.shape, work.dtype),
+                   jax.ShapeDtypeStruct((1,), jnp.int32)],
+        input_output_aliases={1: 0},
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+            vmem_limit_bytes=64 * 1024 * 1024),
+    )(scalars, work, table)
+    return work_out, lt[0]
