@@ -496,15 +496,16 @@ def build_tree_partitioned(
             jax.random.fold_in(key, 987123), gscale, hscale)
     else:
         work0 = pack_rows(jnp.pad(bins, pad), jnp.pad(ghc, pad))
-    if work0.shape[1] < buf_width:
-        # the fused kernel DMAs whole 128-lane tiles; pad row width
-        work0 = jnp.pad(work0, ((0, 0), (0, buf_width - work0.shape[1])))
     if work_buf is not None:
         # reuse the caller's ping-pong pair (fused blocks carry it across
-        # trees): only plane 0 needs writing — stale plane-1 bytes are never
-        # read before being overwritten (blends commit only valid rows)
-        work = work_buf.at[0].set(work0)
+        # trees): only plane 0's used columns need writing — stale bytes
+        # elsewhere are never consumed (blends commit only valid rows, and
+        # the histogram/route reads touch only the used columns)
+        work = work_buf.at[0, :, :work0.shape[1]].set(work0)
     else:
+        if work0.shape[1] < buf_width:
+            # the fused kernel DMAs whole 128-lane tiles; pad row width
+            work0 = jnp.pad(work0, ((0, 0), (0, buf_width - work0.shape[1])))
         work = jnp.stack([work0, jnp.zeros_like(work0)])  # (2, Npad, W)
     part_fn = partition_segment_fused if fused_part else partition_segment
 
@@ -613,8 +614,12 @@ def build_tree_partitioned(
     root_sum_loc = jnp.sum(ghc, axis=0)
     root_sum = comm.root(root_sum_loc)
     root_hist = hist_of(work, jnp.int32(0), jnp.int32(guard), jnp.int32(n))
-    hist_pool = jnp.zeros((num_leaves, num_grp, bm, 3), jnp.float32)
-    hist_pool = hist_pool.at[0].set(root_hist)
+    # the pool is kept FLAT per leaf: 4-D pools make XLA's layout
+    # assignment disagree between the while carry and the gather/update
+    # consumers, inserting a full pool copy per split (measured 2x430 us at
+    # F=137); a 2-D (L, G*B*3) pool has one canonical layout
+    hist_pool = jnp.zeros((num_leaves, num_grp * bm * 3), jnp.float32)
+    hist_pool = hist_pool.at[0].set(root_hist.reshape(-1))
     leaf_sum = jnp.zeros((num_leaves, 3), jnp.float32).at[0].set(root_sum)
     leaf_sum_loc = jnp.zeros((num_leaves, 3), jnp.float32).at[0].set(
         root_sum_loc)
@@ -691,6 +696,7 @@ def build_tree_partitioned(
                 # cond predicate is replicated, so the psum is uniform.
                 hg_forced = comm.psum(hist_pool[fl]) if voting \
                     else hist_pool[fl]
+                hg_forced = hg_forced.reshape(num_grp, bm, 3)
                 fi = find_best_split(
                     feat_view(hg_forced, leaf_sum[fl]),
                     leaf_sum[fl], meta,
@@ -804,12 +810,18 @@ def build_tree_partitioned(
         small_start = jnp.where(left_smaller, start, start + lt)
         small_cnt = jnp.where(left_smaller, lt, cnt - lt)
         hist_small = hist_of(work, new_parity, small_start, small_cnt)
-        parent_hist = hist_pool[leaf]
+        parent_hist = hist_pool[leaf].reshape(num_grp, bm, 3)
         hist_large = parent_hist - hist_small
         hist_left = jnp.where(left_smaller, hist_small, hist_large)
         hist_right = jnp.where(left_smaller, hist_large, hist_small)
-        hist_pool = hist_pool.at[leaf].set(sel(hist_left, parent_hist)) \
-            .at[new_leaf].set(sel(hist_right, hist_pool[new_leaf]))
+        pool_idx = jnp.stack([leaf, new_leaf])
+        if n_forced:
+            old_right = hist_pool[new_leaf].reshape(num_grp, bm, 3)
+            pool_val = jnp.stack([sel(hist_left, parent_hist),
+                                  sel(hist_right, old_right)])
+        else:
+            pool_val = jnp.stack([hist_left, hist_right])
+        hist_pool = hist_pool.at[pool_idx].set(pool_val.reshape(2, -1))
         # local (g,h,cnt) totals per child (voting mode votes with these;
         # any group's bins partition the rows, so group 0 sums the leaf)
         loc_parent = leaf_sum_loc[leaf]
@@ -1102,13 +1114,16 @@ class SerialTreeLearner:
                 # non-TPU backends use the portable XLA pipeline
                 part_kernel = "pallas" if jax.default_backend() in (
                     "tpu", "axon") else "xla"
-            row_w = self.bins.shape[1] + (3 if mode == "int8" else 12)
-            if part_kernel == "pallas" and row_w > 128:
-                # packed rows no longer fit one 128-lane DMA tile
+            from .ops.partition import GH_BYTES, GH_BYTES_Q
+            row_w = self.bins.shape[1] + (GH_BYTES_Q if mode == "int8"
+                                          else GH_BYTES)
+            if part_kernel == "pallas" and row_w > 512:
+                # 512 bytes = 4 DMA lane-tiles; beyond that the permutation
+                # matmul and VMEM scratch stop paying for themselves
                 if not auto_kernel:
                     Log.warning(
                         "tpu_partition_kernel=pallas needs packed rows "
-                        "<= 128 bytes (got %d); using the XLA kernel",
+                        "<= 512 bytes (got %d); using the XLA kernel",
                         row_w)
                 part_kernel = "xla"
             part_chunk = int(config.tpu_part_chunk)

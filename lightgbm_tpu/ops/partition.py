@@ -217,7 +217,7 @@ def work_spec(num_groups: int, quantized: bool, part_kernel: str,
     width = num_groups + (GH_BYTES_Q if quantized else GH_BYTES)
     guard = max(part_chunk, hist_chunk)
     if part_kernel == "pallas":
-        width = 128
+        width = 128 * ((width + 127) // 128)   # whole 128-lane DMA tiles
         guard += 2 * ALIGN
     return guard, width
 
@@ -368,8 +368,10 @@ def partition_segment_fused(
     """
     num_bin = go_left.shape[0]
     width = work.shape[2]
-    if width != 128:
-        raise ValueError("fused partition needs width == 128, got %d" % width)
+    if width % 128:
+        raise ValueError(
+            "fused partition needs width as whole 128-lane tiles, got %d"
+            % width)
     scalars = jnp.stack([src_plane.astype(jnp.int32), start.astype(jnp.int32),
                          cnt.astype(jnp.int32), feat.astype(jnp.int32)])
     table = go_left.astype(jnp.float32).reshape(1, num_bin)
