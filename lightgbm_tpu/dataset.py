@@ -489,7 +489,8 @@ def _extract_binned(X, ds: BinnedDataset) -> np.ndarray:
     else:
         Xv = np.asarray(X, dtype=np.float64)
 
-    for gid, grp in enumerate(ds.groups):
+    def fill_group(gid: int) -> None:
+        grp = ds.groups[gid]
         multi = len(grp.feature_indices) > 1
         for j, off in zip(grp.feature_indices, grp.bin_offsets):
             m = ds.bin_mappers[j]
@@ -518,6 +519,30 @@ def _extract_binned(X, ds: BinnedDataset) -> np.ndarray:
                     out[nz, gid] = bb[nz].astype(dtype)
                 else:
                     out[:, gid] = b.astype(dtype)
+
+    # Dense single-feature numerical groups bin through the native threaded
+    # applier (native/binning.cpp — the reference's OpenMP PushData analog,
+    # src/io/dataset.cpp:318); numpy's searchsorted holds the GIL, costing
+    # ~4 s alone at 2M x 28. Bundled/categorical/u16 groups keep the exact
+    # numpy path.
+    done = set()
+    if not sparse and dtype == np.uint8:
+        from .io_native import apply_bins_native
+        specs = []
+        for gid, grp in enumerate(ds.groups):
+            if len(grp.feature_indices) != 1:
+                continue
+            j = grp.feature_indices[0]
+            m = ds.bin_mappers[j]
+            if m.bin_type != BIN_NUMERICAL:
+                continue
+            specs.append((ds.used_feature_indices[j], m.upper_bounds,
+                          m.missing_type, m.missing_bin, gid))
+        if specs and apply_bins_native(Xv, specs, out):
+            done = {s[4] for s in specs}
+    for gid in range(len(ds.groups)):
+        if gid not in done:
+            fill_group(gid)
     return out
 
 

@@ -21,29 +21,32 @@ _LIB: Optional[ctypes.CDLL] = None
 _TRIED = False
 
 
-def _build_lib() -> Optional[str]:
+def _build_lib(src_name: str = "parser.cpp",
+               lib_name: str = "libparser.so",
+               extra_flags: tuple = ()) -> Optional[str]:
     src = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                       "native", "parser.cpp")
+                       "native", src_name)
     # per-user cache dir (a fixed world-writable /tmp path would allow
     # another local user to plant a library) + atomic rename so concurrent
     # builders never dlopen a half-written file
     out_dir = os.environ.get("LIGHTGBM_TPU_CACHE") or os.path.join(
         os.path.expanduser("~"), ".cache", "lightgbm_tpu")
     os.makedirs(out_dir, exist_ok=True)
-    out = os.path.join(out_dir, "libparser.so")
+    out = os.path.join(out_dir, lib_name)
     if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
         return out
     fd, tmp = tempfile.mkstemp(suffix=".so", dir=out_dir)
     os.close(fd)
     cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++14", "-o", tmp, src]
+    cmd[1:1] = list(extra_flags)
     try:
         r = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
     except (OSError, subprocess.TimeoutExpired) as e:
-        Log.debug("native parser build unavailable: %s", e)
+        Log.debug("native build unavailable (%s): %s", src_name, e)
         return None
     if r.returncode != 0:
-        Log.warning("native parser build failed; using the Python parser:\n%s",
-                    r.stderr[-500:])
+        Log.warning("native build of %s failed; using the Python path:\n%s",
+                    src_name, r.stderr[-500:])
         os.unlink(tmp)
         return None
     os.replace(tmp, out)
@@ -111,3 +114,64 @@ def parse_file(path: str,
     if rc != 0:
         return None
     return out, fmt
+
+
+# ---------------------------------------------------------------------------
+# Native threaded bin application (native/binning.cpp)
+# ---------------------------------------------------------------------------
+
+_BIN_LIB: Optional[ctypes.CDLL] = None
+_BIN_TRIED = False
+
+
+def get_binning_lib() -> Optional[ctypes.CDLL]:
+    global _BIN_LIB, _BIN_TRIED
+    if _BIN_TRIED:
+        return _BIN_LIB
+    _BIN_TRIED = True
+    path = _build_lib("binning.cpp", "libbinning.so", ("-pthread",))
+    if path is None:
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+        f64p = np.ctypeslib.ndpointer(dtype=np.float64, flags="C_CONTIGUOUS")
+        i32p = np.ctypeslib.ndpointer(dtype=np.int32, flags="C_CONTIGUOUS")
+        i64p = np.ctypeslib.ndpointer(dtype=np.int64, flags="C_CONTIGUOUS")
+        u8p = np.ctypeslib.ndpointer(dtype=np.uint8, flags="C_CONTIGUOUS")
+        lib.lgbm_apply_bins_u8.argtypes = [
+            f64p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32, i32p,
+            f64p, i64p, i32p, i32p, i32p, u8p, ctypes.c_int64, i32p,
+            ctypes.c_int32]
+        lib.lgbm_apply_bins_u8.restype = None
+    except (OSError, AttributeError) as e:
+        # a corrupted/stale cached .so must degrade to the numpy path, the
+        # same contract as compile failures in _build_lib
+        Log.warning("native binning library unusable (%s); using numpy", e)
+        return None
+    _BIN_LIB = lib
+    return lib
+
+
+def apply_bins_native(Xv: np.ndarray, specs, out: np.ndarray) -> bool:
+    """Bin a batch of numerical features into `out` columns natively.
+
+    specs: list of (x_col, upper_bounds f64 array, missing_type,
+    missing_bin, out_col). Returns False when the native library is
+    unavailable (caller falls back to numpy searchsorted).
+    """
+    lib = get_binning_lib()
+    if lib is None or not specs:
+        return False
+    col_idx = np.asarray([s[0] for s in specs], np.int32)
+    bounds_cat = np.concatenate([np.asarray(s[1], np.float64) for s in specs])
+    off = np.zeros(len(specs), np.int64)
+    nb = np.asarray([len(s[1]) for s in specs], np.int32)
+    np.cumsum(nb[:-1], out=off[1:])
+    mtype = np.asarray([s[2] for s in specs], np.int32)
+    mbin = np.asarray([s[3] for s in specs], np.int32)
+    ocol = np.asarray([s[4] for s in specs], np.int32)
+    lib.lgbm_apply_bins_u8(
+        np.ascontiguousarray(Xv), Xv.shape[0], Xv.shape[1],
+        np.int32(len(specs)), col_idx, bounds_cat, off, nb, mtype, mbin,
+        out, out.shape[1], ocol, np.int32(os.cpu_count() or 1))
+    return True

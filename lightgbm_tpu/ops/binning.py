@@ -117,7 +117,13 @@ def _greedy_find_bin(
                 cur = 0
         bounds.append(np.inf)
         return bounds
-    # more distinct values than bins: equal-density with "big value" carve-out
+    # More distinct values than bins: equal-density with "big value"
+    # carve-out. Iterates per BIN (<= max_bin steps of searchsorted over the
+    # cumulative counts) instead of per distinct value — the per-value loop
+    # cost ~50 ms/feature at a 200k sample, dominating Dataset construction.
+    # Greedy close rule per value index i (reference GreedyFindBin order):
+    #   a) counts[i] is "big"  b) bin count >= mean and >= min_data_in_bin
+    #   c) counts[i+1] is big and bin count >= max(1, min_data_in_bin)
     max_bin = max(1, max_bin)
     mean_size = total_cnt / max_bin
     is_big = counts > mean_size
@@ -127,21 +133,32 @@ def _greedy_find_bin(
         mean_size = rest_cnt / rest_bins
     else:
         mean_size = np.inf
-    bin_cnt = 0.0
-    for i in range(n):
-        bin_cnt += counts[i]
-        close_bin = False
-        if is_big[i]:
-            close_bin = True
-        elif bin_cnt >= mean_size and bin_cnt >= min_data_in_bin:
-            close_bin = True
-        elif i + 1 < n and is_big[i + 1] and bin_cnt >= max(1, min_data_in_bin):
-            close_bin = True
-        if close_bin and i + 1 < n:
-            bounds.append((distinct_values[i] + distinct_values[i + 1]) / 2.0)
-            bin_cnt = 0.0
-        if len(bounds) >= max_bin - 1:
+    csum = np.cumsum(counts, dtype=np.float64)
+    big_pos = np.flatnonzero(is_big)                  # ascending value indexes
+    pre_big = big_pos[big_pos > 0] - 1                # i with is_big[i+1]
+    min_d = float(min_data_in_bin)
+    need_b_extra = max(mean_size, min_d)
+    need_c_extra = max(1.0, min_d)
+    start = 0
+    base = 0.0                                        # csum before `start`
+    while start < n and len(bounds) < max_bin - 1:
+        # first i >= start satisfying each close rule
+        k = np.searchsorted(big_pos, start)
+        i_a = int(big_pos[k]) if k < len(big_pos) else n
+        i_b = int(np.searchsorted(csum, base + need_b_extra, side="left")) \
+            if np.isfinite(need_b_extra) else n
+        # rule c needs BOTH is_big[i+1] and the count condition at the same
+        # i; pre-big positions are sorted and the count condition is
+        # i >= first index reaching base + need_c
+        i_c_cnt = int(np.searchsorted(csum, base + need_c_extra, side="left"))
+        kc = np.searchsorted(pre_big, max(start, i_c_cnt))
+        i_c = int(pre_big[kc]) if kc < len(pre_big) else n
+        close = min(i_a, i_b, i_c)
+        if close >= n - 1:
             break
+        bounds.append((distinct_values[close] + distinct_values[close + 1]) / 2.0)
+        start = close + 1
+        base = float(csum[close])
     bounds.append(np.inf)
     return bounds
 
