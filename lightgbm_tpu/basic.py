@@ -144,6 +144,9 @@ class Dataset:
         return _to_2d(self.data).shape[1]
 
     def construct(self, params: Optional[Dict[str, Any]] = None) -> BinnedDataset:
+        if self._constructed is not None and self.data is None:
+            # externally constructed (two-round loader): binning is fixed
+            return self._constructed
         merged = dict(self.params)
         if params:
             merged.update(params)
@@ -285,6 +288,15 @@ class Booster:
                 num_iteration: Optional[int] = None, pred_leaf: bool = False,
                 pred_contrib: bool = False, **kwargs) -> np.ndarray:
         X = _to_2d(data)
+        expected = self.num_feature()
+        if expected > 0 and X.shape[1] != expected \
+                and not self.config.predict_disable_shape_check:
+            from .utils.log import Log
+            Log.fatal(
+                "The number of features in data (%d) is not the same as in "
+                "the model (%d). Set predict_disable_shape_check=true to "
+                "bypass (reference: LGBM_BoosterPredict shape check).",
+                X.shape[1], expected)
         if num_iteration is None:
             # early stopping: default to the best iteration like the
             # reference python package (basic.py Booster.predict)
@@ -329,20 +341,46 @@ class Booster:
             return self.inner.train_set.feature_names
         return getattr(self.inner, "_feature_names", [])
 
+    def num_feature(self) -> int:
+        """Number of features the model was trained on (reference:
+        LGBM_BoosterGetNumFeature); -1 when unknown (featureless model)."""
+        if self.inner.train_set is not None:
+            return self.inner.train_set.num_total_features
+        names = getattr(self.inner, "_feature_names", None)
+        if names:
+            return len(names)
+        return -1
+
     def reset_parameter(self, params: Dict[str, Any]) -> "Booster":
         """(reference: Booster::ResetConfig path, gbdt.cpp:684)"""
         self.params.update(params)
         self.config.set(params)
-        # refresh learner hyperparameters that affect future trees
-        if self.inner.learner is not None:
-            from .learner import SerialTreeLearner
-            self.inner.learner = SerialTreeLearner(
-                self.config, self.inner.train_set, self.inner.comm_axis)
+        inner = self.inner
+        # refresh learner hyperparameters that affect future trees,
+        # PRESERVING the learner class: a Data/Feature/Voting mesh learner
+        # must not silently downgrade to SerialTreeLearner mid-training
+        if inner.learner is not None:
+            from .parallel.mesh import _MeshTreeLearner, create_tree_learner
+            mesh = inner.learner.mesh \
+                if isinstance(inner.learner, _MeshTreeLearner) else None
+            inner.learner = create_tree_learner(
+                self.config, inner.train_set, mesh)
+        # drop cached state derived from the old config (samplers, column
+        # masks, fused block functions)
+        for attr in ("_sampler_fn", "_fmask_fn"):
+            if hasattr(inner, attr):
+                delattr(inner, attr)
+        inner._fused = None
         return self
 
-    def refit(self, data, label, decay_rate: Optional[float] = None, **kwargs):
+    def refit(self, data, label, decay_rate: Optional[float] = None,
+              weight=None, group=None, **kwargs):
         """Refit leaf values on new data (reference: GBDT::RefitTree,
-        gbdt.cpp:285; python Booster.refit)."""
+        gbdt.cpp:285; python Booster.refit).
+
+        ``weight``/``group`` carry the new data's metadata — ranking and
+        weighted objectives need them to form correct gradients (a bare
+        label stub would crash lambdarank or silently mis-weight)."""
         decay = self.config.refit_decay_rate if decay_rate is None else decay_rate
         X = _to_2d(data)
         y = _to_1d(label)
@@ -350,15 +388,19 @@ class Booster:
         K = new_booster.inner.num_tree_per_iteration
         score = np.zeros((X.shape[0], K))
         score += new_booster.inner.init_scores[None, :K]
+        from .dataset import Metadata
+        meta = Metadata(num_data=len(y), label=np.asarray(y, np.float32),
+                        weight=None if weight is None else _to_1d(weight),
+                        group=None if group is None else _to_1d(group))
+        obj = new_booster.inner.objective
+        if obj.is_ranking and meta.query_boundaries is None:
+            from .utils.log import Log
+            Log.fatal("refit with a ranking objective requires group=")
+        obj.init(meta)
         for i, tree in enumerate(new_booster.inner.models):
             leaf_idx = tree.predict_leaf_index(X)
             # grad at current score for this class
             import jax.numpy as jnp
-            obj = new_booster.inner.objective
-            obj.init(type("M", (), {
-                "num_data": len(y),
-                "label": np.asarray(y, np.float32),
-                "weight": None, "init_score": None, "query_boundaries": None})())
             s = jnp.asarray(score if K > 1 else score.ravel(), jnp.float32)
             g, h = obj.get_gradients(s)
             g = np.asarray(g).reshape(len(y), -1)[:, i % K]

@@ -201,48 +201,33 @@ class GBDT:
     # --------------------------------------------------------------- sampling
     def _bagging(self, it: int, grad: jax.Array, hess: jax.Array) -> None:
         """Refresh the in-bag mask (reference: gbdt.cpp:228 Bagging,
-        goss.hpp:103 for data_sample_strategy=goss)."""
+        goss.hpp:103 for data_sample_strategy=goss).
+
+        Uses the SAME seed-derived samplers as the fused device blocks
+        (fused.make_sampler), so a given config trains the identical model
+        through either path."""
         cfg = self.config
-        n = self.train_set.num_data
-        if cfg.data_sample_strategy == "goss":
-            warmup = int(1.0 / max(cfg.learning_rate, 1e-12))
-            if it < warmup or cfg.top_rate + cfg.other_rate >= 1.0:
-                self._inbag = jnp.ones((n,), jnp.float32)
-                self._amp = None
-                return
-            g = grad if grad.ndim == 1 else jnp.sum(jnp.abs(grad), axis=1)
-            h = hess if hess.ndim == 1 else jnp.sum(jnp.abs(hess), axis=1)
-            s = jnp.abs(g * h)
-            top_k = max(1, int(n * cfg.top_rate))
-            thr = jnp.sort(s)[n - top_k]
-            is_top = s >= thr
-            key = jax.random.fold_in(self._key, 7000 + it)
-            rest_rate = cfg.other_rate / max(1e-12, 1.0 - cfg.top_rate)
-            sampled = (jax.random.uniform(key, (n,)) < rest_rate) & ~is_top
-            amp = (1.0 - cfg.top_rate) / max(cfg.other_rate, 1e-12)
-            self._inbag = (is_top | sampled).astype(jnp.float32)
-            self._amp = jnp.where(sampled, amp, 1.0).astype(jnp.float32)
+        if not hasattr(self, "_sampler_fn"):
+            from .fused import make_balanced_sampler, make_sampler
+            lab = self.objective.label if self.objective is not None else None
+            # GOSS takes precedence over any bagging params (the reference's
+            # data_sample_strategy switch, gbdt.cpp:228)
+            if cfg.data_sample_strategy != "goss" \
+                    and (cfg.pos_bagging_fraction < 1.0
+                         or cfg.neg_bagging_fraction < 1.0) \
+                    and cfg.bagging_freq > 0 and lab is not None:
+                self._sampler_fn = make_balanced_sampler(cfg, lab)
+            else:
+                self._sampler_fn = make_sampler(cfg,
+                                                self.train_set.num_data)
+        if self._sampler_fn is None:
+            self._amp = None
             return
-        self._amp = None
-        need = cfg.bagging_freq > 0 and (
-            cfg.bagging_fraction < 1.0
-            or cfg.pos_bagging_fraction < 1.0 or cfg.neg_bagging_fraction < 1.0)
-        if not need:
-            return
-        if it % cfg.bagging_freq != 0 and self._inbag is not None and it > 0:
-            return
-        rng = np.random.RandomState(cfg.bagging_seed + it)
-        lab = self.train_set.metadata.label
-        if (cfg.pos_bagging_fraction < 1.0 or cfg.neg_bagging_fraction < 1.0) \
-                and lab is not None:
-            # balanced bagging (reference: gbdt.cpp:199 BalancedBaggingHelper)
-            mask = np.zeros(n, dtype=np.float32)
-            pos = lab > 0
-            mask[pos] = rng.rand(int(pos.sum())) < cfg.pos_bagging_fraction
-            mask[~pos] = rng.rand(int((~pos).sum())) < cfg.neg_bagging_fraction
-        else:
-            mask = (rng.rand(n) < cfg.bagging_fraction).astype(np.float32)
-        self._inbag = jnp.asarray(mask, jnp.float32)
+        g = grad if grad.ndim == 1 else jnp.sum(jnp.abs(grad), axis=1)
+        h = hess if hess.ndim == 1 else jnp.sum(jnp.abs(hess), axis=1)
+        inbag, amp = self._sampler_fn(None, it, g, h)
+        self._inbag = inbag
+        self._amp = amp if cfg.data_sample_strategy == "goss" else None
 
     def _tree_channels(self, grad: jax.Array, hess: jax.Array, k: int) -> jax.Array:
         g = grad if grad.ndim == 1 else grad[:, k]
@@ -255,14 +240,12 @@ class GBDT:
     def _feature_mask(self, it: int) -> jax.Array:
         cfg = self.config
         nf = self.train_set.num_features
-        mask = np.ones(nf, dtype=bool)
-        if cfg.feature_fraction < 1.0:
-            k = max(1, int(np.ceil(cfg.feature_fraction * nf)))
-            rng = np.random.RandomState(cfg.feature_fraction_seed + it)
-            chosen = rng.choice(nf, size=k, replace=False)
-            mask = np.zeros(nf, dtype=bool)
-            mask[chosen] = True
-        return jnp.asarray(mask)
+        if not hasattr(self, "_fmask_fn"):
+            from .fused import make_feature_mask_fn
+            self._fmask_fn = make_feature_mask_fn(cfg, nf)
+        if self._fmask_fn is None:
+            return jnp.ones((nf,), bool)
+        return self._fmask_fn(it)
 
     # --------------------------------------------------------------- training
     def train_one_iter(self, grad: Optional[np.ndarray] = None,
@@ -534,6 +517,44 @@ class GBDT:
     DEVICE_PREDICT_MIN_ROWS = 512
 
     def _raw_scores(self, X: np.ndarray, start: int, end: int) -> np.ndarray:
+        """Ensemble raw scores with optional prediction early stopping
+        (reference: src/boosting/prediction_early_stop.cpp — rows whose
+        margin exceeds pred_early_stop_margin stop accumulating trees,
+        checked every pred_early_stop_freq iterations)."""
+        cfg = self.config
+        K = self.num_tree_per_iteration
+        es = bool(cfg.pred_early_stop) and self.objective is not None \
+            and (self.objective.name in ("binary",)
+                 or (K > 1 and "multiclass" in self.objective.name))
+        if not es:
+            return self._raw_scores_range(X, start, end)
+        freq = max(1, int(cfg.pred_early_stop_freq))
+        margin_thr = float(cfg.pred_early_stop_margin)
+        n = X.shape[0]
+        score = np.zeros((n, K), dtype=np.float64)
+        active = np.ones(n, dtype=bool)
+        # the margin the reference thresholds is that of the FINAL score,
+        # which includes boost_from_average init scores
+        init = self.init_scores[None, :K]
+        for b0 in range(start, end, freq):
+            if not active.any():
+                break
+            b1 = min(end, b0 + freq)
+            sub = X[active]
+            score[active] += self._raw_scores_range(sub, b0, b1)
+            full = score[active] + init
+            if K == 1:
+                margin = 2.0 * np.abs(full[:, 0])
+            else:
+                top2 = np.partition(full, K - 2, axis=1)[:, K - 2:]
+                margin = np.max(top2, axis=1) - np.min(top2, axis=1)
+            still = margin <= margin_thr
+            idx = np.flatnonzero(active)
+            active[idx[~still]] = False
+        return score
+
+    def _raw_scores_range(self, X: np.ndarray, start: int,
+                          end: int) -> np.ndarray:
         """Ensemble raw scores (N, K) over model range [start*K, end*K).
 
         Large batches route on device (reference analog:
@@ -549,11 +570,15 @@ class GBDT:
 
             key = (start, end, len(self.models),
                    id(self.models[-1]) if self.models else 0)
-            cached = getattr(self, "_pack_cache", None)
-            if cached is None or cached[0] != key:
-                pack, has_cat = pack_splits(models, num_class=K)
-                self._pack_cache = (key, pack, has_cat)
-            _, pack, has_cat = self._pack_cache
+            cache = getattr(self, "_pack_cache", None)
+            if cache is None or not isinstance(cache, dict):
+                cache = self._pack_cache = {}
+            hit = cache.get(key)
+            if hit is None:
+                if len(cache) > 64:
+                    cache.clear()
+                hit = cache[key] = pack_splits(models, num_class=K)
+            pack, has_cat = hit
             score = predict_raw(jnp.asarray(X, jnp.float32), pack,
                                 num_class=K, has_cat=has_cat)
             out = np.asarray(score, np.float64)
@@ -615,6 +640,25 @@ class GBDT:
             lines.append(tree.to_text())
             lines.append("")
         lines.append("end of trees")
+        # saved_feature_importance_type selects the importance measure
+        # written into the model file (reference: gbdt_model_text.cpp:100
+        # SaveModelToString -> FeatureImportance(.., type))
+        itype = "gain" if int(cfg.saved_feature_importance_type) == 1 \
+            else "split"
+        try:
+            imps = self.feature_importance(itype, num_iteration)
+            names = self.train_set.feature_names if self.train_set \
+                else getattr(self, "_feature_names", [])
+            pairs = [(float(v), names[i] if i < len(names) else
+                      "Column_%d" % i) for i, v in enumerate(imps) if v > 0]
+            pairs.sort(key=lambda p: -p[0])
+            lines.append("")
+            lines.append("feature_importances:")
+            for v, name in pairs:
+                lines.append("%s=%.17g" % (name, v)
+                             if itype == "gain" else "%s=%d" % (name, int(v)))
+        except Exception:  # importances are informational; never block IO
+            pass
         return "\n".join(lines)
 
     def _objective_string(self) -> str:

@@ -65,6 +65,13 @@ class Application:
 
     def _load_train_data(self) -> Dataset:
         cfg = self.config
+        if cfg.two_round:
+            from .io import load_dataset_two_round
+            binned = load_dataset_two_round(cfg.data, cfg)
+            if binned is not None:
+                ds = Dataset(None, params=dict(self.raw_params))
+                ds._constructed = binned
+                return ds
         X, label, weight, group, names = load_text_file(cfg.data, cfg)
         return Dataset(X, label=label, weight=weight, group=group,
                        feature_name=names or "auto",

@@ -204,9 +204,7 @@ class Config:
     gpu_use_dp: bool = False
     num_gpu: int = 1
     # TPU-specific knobs (no reference analog):
-    tpu_hist_dtype: str = "float32"  # histogram accumulation dtype
     tpu_rows_per_chunk: int = 65536  # rows per device histogram chunk
-    tpu_donate_buffers: bool = True
     tpu_iter_block: int = 10         # boosting iterations fused per device launch
     tree_builder: str = "auto"       # auto|partition|dense: partitioned
     #   leaf-contiguous builder (O(child) histograms) vs round-1 dense
@@ -221,8 +219,6 @@ class Config:
     use_quantized_grad: bool = False  # int8 stochastic gradient quantization
     #   (LightGBM 4.x quantized training analog; rows per leaf <= ~16M)
 
-    # resolved, not user-set
-    num_original_features: int = 0
 
     def __post_init__(self) -> None:
         # direct-constructor path must validate/normalize too (goss -> gbdt+goss)
@@ -269,6 +265,17 @@ class Config:
             Log.fatal("GOSS requires top_rate + other_rate <= 1.0")
         if self.objective in ("multiclass", "multiclassova", "softmax", "ova") and self.num_class <= 1:
             Log.fatal("num_class must be > 1 for multiclass objectives")
+        warned = getattr(self, "_noop_warned", None)
+        if warned is None:
+            warned = set()
+            object.__setattr__(self, "_noop_warned", warned)
+        for name, (default, reason) in NOOP_PARAMS.items():
+            if name in warned:
+                continue
+            if getattr(self, name) != default:
+                warned.add(name)
+                Log.warning("%s is accepted but has no effect here: %s",
+                            name, reason)
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -388,6 +395,40 @@ _alias("local_listen_port", "local_port", "port")
 _alias("machine_list_filename", "machine_list_file", "machine_list", "mlist")
 _alias("machines", "workers", "nodes")
 
+
+
+# Parameters the reference implements but that have no effect in this
+# framework's TPU design. Each maps to (default, reason). Setting one to a
+# non-default value warns ONCE with the reason (same contract as the
+# `machines` warning) — nothing is silently ignored; the audit test
+# (tests/test_param_audit.py) enforces that every config field is either
+# consumed by the code or listed here.
+NOOP_PARAMS: Dict[str, tuple] = {
+    "force_col_wise": (False, "the TPU histogram layout is fixed (dense "
+                       "bundled columns on the MXU one-hot path)"),
+    "force_row_wise": (False, "the TPU histogram layout is fixed"),
+    "is_enable_sparse": (True, "sparse inputs are EFB-bundled into the "
+                         "dense matrix at construction; storage is dense"),
+    "histogram_pool_size": (-1.0, "the histogram pool is leaf-count sized "
+                            "in HBM; there is no host-side pool to cap"),
+    "deterministic": (False, "training is already deterministic for a "
+                      "fixed config on a fixed topology"),
+    "num_gpu": (1, "the JAX TPU backend is used; gpu_* options select the "
+                "reference's OpenCL/CUDA code paths"),
+    "gpu_platform_id": (-1, "the JAX TPU backend is used"),
+    "gpu_device_id": (-1, "the JAX TPU backend is used"),
+    "gpu_use_dp": (False, "the JAX TPU backend is used; histograms "
+                   "accumulate in float32 (tpu_hist_precision)"),
+    "device_type": ("tpu", "cpu/gpu/cuda select the reference's backends; "
+                    "every value runs the JAX backend here"),
+    "local_listen_port": (12400, "the reference's socket cluster port; "
+                          "multi-host runs bootstrap via "
+                          "parallel.distributed.init_distributed"),
+    "time_out": (120, "the reference's socket timeout; jax.distributed "
+                 "manages connection timeouts"),
+    "machine_list_filename": ("", "the reference's socket cluster file; "
+                              "use init_distributed(coordinator_address=...)"),
+}
 
 def resolve_aliases(params: Dict[str, Any]) -> Dict[str, Any]:
     """Resolve aliases; canonical names win over aliases on conflict

@@ -267,7 +267,7 @@ def construct_dataset(
         ds.feature_names = reference.feature_names
         ds.monotone_constraints = reference.monotone_constraints
         ds.feature_penalty = reference.feature_penalty
-        ds.binned = _extract_binned(X, ds)
+        ds.binned = _extract_binned(X, ds, nthreads=int(config.num_threads))
         ds.metadata = Metadata(num_data, label, weight, group, init_score)
         if config.linear_tree:
             ds.raw_numeric = _raw_numeric(X, ds)
@@ -367,7 +367,7 @@ def construct_dataset(
                 fp[i] = config.feature_contri[f]
         ds.feature_penalty = fp
 
-    ds.binned = _extract_binned(X, ds)
+    ds.binned = _extract_binned(X, ds, nthreads=int(config.num_threads))
     ds.metadata = Metadata(num_data, label, weight, group, init_score)
     if config.linear_tree:
         ds.raw_numeric = _raw_numeric(X, ds)
@@ -469,7 +469,8 @@ def _bundle_bin(m: BinMapper, bins: np.ndarray, offset: int) -> np.ndarray:
     return np.where(bins == d, 0, offset + adj)
 
 
-def _extract_binned(X, ds: BinnedDataset) -> np.ndarray:
+def _extract_binned(X, ds: BinnedDataset,
+                    nthreads: int = 0) -> np.ndarray:
     """Bin every row into the (num_data, num_groups) bundled matrix.
 
     EFB (reference: Dataset::Construct + FeatureGroup::PushData,
@@ -538,7 +539,7 @@ def _extract_binned(X, ds: BinnedDataset) -> np.ndarray:
                 continue
             specs.append((ds.used_feature_indices[j], m.upper_bounds,
                           m.missing_type, m.missing_bin, gid))
-        if specs and apply_bins_native(Xv, specs, out):
+        if specs and apply_bins_native(Xv, specs, out, nthreads=nthreads):
             done = {s[4] for s in specs}
     for gid in range(len(ds.groups)):
         if gid not in done:

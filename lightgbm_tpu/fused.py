@@ -109,14 +109,25 @@ def _small(log: TreeLog) -> BlockLogs:
         go_left=log.go_left, leaf_value=log.leaf_value)
 
 
+def _seed_key(seed: int) -> jax.Array:
+    return jax.random.PRNGKey(int(seed) & 0x7FFFFFFF)
+
+
 def make_sampler(config: Config, num_data: int):
-    """In-graph (inbag, amplification) masks; None when sampling is off."""
+    """In-graph (inbag, amplification) masks; None when sampling is off.
+
+    The RNG streams derive from ``bagging_seed`` alone (NOT the boosting
+    key), so the eager host loop and the fused device blocks draw IDENTICAL
+    masks for the same config — the reference's seed contract
+    (config.h bagging_seed; gbdt.cpp:228 Bagging uses its own Random).
+    """
     cfg = config
     if cfg.data_sample_strategy == "goss":
         warmup = int(1.0 / max(cfg.learning_rate, 1e-12))
         top_rate, other_rate = cfg.top_rate, cfg.other_rate
         if top_rate + other_rate >= 1.0:
             return None
+        base = _seed_key(cfg.bagging_seed)
 
         def goss(key, it, g, h):
             s = jnp.abs(g * h) if g.ndim == 1 else jnp.sum(jnp.abs(g * h), axis=1)
@@ -124,7 +135,8 @@ def make_sampler(config: Config, num_data: int):
             thr = jnp.sort(s)[num_data - top_k]
             is_top = s >= thr
             rest_rate = other_rate / max(1e-12, 1.0 - top_rate)
-            u = jax.random.uniform(jax.random.fold_in(key, 7000 + it), (num_data,))
+            u = jax.random.uniform(jax.random.fold_in(base, 7000 + it),
+                                   (num_data,))
             sampled = (u < rest_rate) & ~is_top
             amp = (1.0 - top_rate) / max(other_rate, 1e-12)
             inbag = (is_top | sampled).astype(jnp.float32)
@@ -140,10 +152,12 @@ def make_sampler(config: Config, num_data: int):
     if not need:
         return None
     freq = max(1, cfg.bagging_freq)
+    base = _seed_key(cfg.bagging_seed)
 
     def bagging(key, it, g, h):
         rnd = it // freq
-        u = jax.random.uniform(jax.random.fold_in(key, 9000 + rnd), (num_data,))
+        u = jax.random.uniform(jax.random.fold_in(base, 9000 + rnd),
+                               (num_data,))
         mask = (u < cfg.bagging_fraction).astype(jnp.float32)
         return mask, jnp.ones((num_data,), jnp.float32)
 
@@ -154,15 +168,35 @@ def make_balanced_sampler(config: Config, label: jax.Array):
     cfg = config
     freq = max(1, cfg.bagging_freq)
     pos = label > 0
+    base = _seed_key(cfg.bagging_seed)
 
     def bagging(key, it, g, h):
         rnd = it // freq
-        u = jax.random.uniform(jax.random.fold_in(key, 9000 + rnd), label.shape)
+        u = jax.random.uniform(jax.random.fold_in(base, 9000 + rnd),
+                               label.shape)
         mask = jnp.where(pos, u < cfg.pos_bagging_fraction,
                          u < cfg.neg_bagging_fraction).astype(jnp.float32)
         return mask, jnp.ones(label.shape, jnp.float32)
 
     return bagging
+
+
+def make_feature_mask_fn(config: Config, num_feat: int):
+    """Per-iteration by-tree column mask; shared by eager and fused paths
+    (stream derives from feature_fraction_seed)."""
+    cfg = config
+    if cfg.feature_fraction >= 1.0:
+        return None
+    kk = max(1, int(np.ceil(cfg.feature_fraction * num_feat)))
+    base = _seed_key(cfg.feature_fraction_seed)
+
+    def fmask(it):
+        u = jax.random.uniform(jax.random.fold_in(base, 555 + it),
+                               (num_feat,))
+        rank = jnp.argsort(jnp.argsort(u))
+        return rank < kk
+
+    return fmask
 
 
 class FusedTrainer:
@@ -174,7 +208,9 @@ class FusedTrainer:
         self.config: Config = gbdt.config
         cfg = self.config
         self._balanced = bool(
-            (cfg.pos_bagging_fraction < 1.0 or cfg.neg_bagging_fraction < 1.0)
+            cfg.data_sample_strategy != "goss"
+            and (cfg.pos_bagging_fraction < 1.0
+                 or cfg.neg_bagging_fraction < 1.0)
             and cfg.bagging_freq > 0 and gbdt.objective.label is not None)
         self.num_feat = gbdt.train_set.num_features
 
@@ -210,7 +246,7 @@ class FusedTrainer:
         lr = float(cfg.learning_rate)
         balanced = self._balanced
         nf = self.num_feat
-        ffrac = float(cfg.feature_fraction)
+        fmask_fn = make_feature_mask_fn(cfg, nf)
         build = learner.make_build_fn()
         wspec = learner.work_buf_spec()
 
@@ -223,11 +259,8 @@ class FusedTrainer:
                 inbag, amp = sampler(key, it, g, h)
             else:
                 inbag = amp = None
-            if ffrac < 1.0:
-                kk = max(1, int(np.ceil(ffrac * nf)))
-                u = jax.random.uniform(jax.random.fold_in(key, 555 + it), (nf,))
-                rank = jnp.argsort(jnp.argsort(u))
-                fmask = rank < kk
+            if fmask_fn is not None:
+                fmask = fmask_fn(it)
             else:
                 fmask = jnp.ones((nf,), bool)
             logs = []

@@ -180,3 +180,146 @@ def load_config_file(path: str) -> Dict[str, str]:
             k, v = line.split("=", 1)
             out[k.strip()] = v.strip()
     return out
+
+
+# ---------------------------------------------------------------------------
+# Two-round (low-memory) loading
+# ---------------------------------------------------------------------------
+
+def _dense_line_chunks(filename: str, skip: int, sep, chunk_rows: int):
+    """Stream a dense text file as parsed float chunks (never the whole
+    matrix)."""
+    buf: List[str] = []
+    with open(filename) as f:
+        for _ in range(skip):
+            f.readline()
+        for line in f:
+            if line.strip():
+                buf.append(line)
+            if len(buf) >= chunk_rows:
+                yield np.loadtxt(buf, delimiter=sep, ndmin=2)
+                buf = []
+    if buf:
+        yield np.loadtxt(buf, delimiter=sep, ndmin=2)
+
+
+def load_dataset_two_round(filename: str, config: Config,
+                           chunk_rows: int = 200_000):
+    """Two-pass low-memory dataset construction (reference:
+    DatasetLoader two-round path, src/io/dataset_loader.cpp — sample on the
+    first pass, bin row blocks on the second; the raw double matrix is
+    never materialized).
+
+    Pass 1 streams the file once: counts rows, collects label/weight/group
+    columns and a uniform reservoir sample of feature rows. The sample
+    drives bin finding / EFB / trivial-feature pruning exactly like the
+    in-memory path (which also samples, bin_construct_sample_cnt). Pass 2
+    streams again, binning each block straight into the final uint8 matrix.
+    """
+    from .dataset import Metadata, _extract_binned, construct_dataset
+
+    if not os.path.exists(filename):
+        Log.fatal("Data file %s does not exist", filename)
+    if config.linear_tree:
+        Log.fatal("two_round does not keep raw values; disable linear_tree "
+                  "or two_round")
+    with open(filename) as f:
+        head = [f.readline() for _ in range(3)]
+    has_header = bool(config.header)
+    fmt = detect_format(head[1 if has_header else 0:])
+    if fmt == "libsvm":
+        Log.warning("two_round supports dense text; using the standard "
+                    "libsvm loader")
+        return None
+    sep = "," if fmt == "csv" else ("\t" if fmt == "tsv" else None)
+    header_names = None
+    skip = 0
+    if has_header:
+        header_names = [c.strip() for c in head[0].strip().split(sep)] \
+            if sep else None
+        skip = 1
+
+    data_line = next((l for l in head[skip:] if l and l.strip()), None)
+    if data_line is None:
+        Log.fatal("Data file %s has no data rows", filename)
+    first = np.loadtxt([data_line], delimiter=sep, ndmin=2)
+    ncol = first.shape[1]
+    label_idx = _parse_column_spec(config.label_column or "0", header_names)
+    weight_idx = _parse_column_spec(config.weight_column, header_names)
+    group_idx = _parse_column_spec(config.group_column, header_names)
+    ignore: set = set()
+    if config.ignore_column:
+        for tok in str(config.ignore_column).split(","):
+            if tok:
+                ignore.add(_parse_column_spec(tok, header_names))
+    special = {label_idx} | ignore
+    if weight_idx >= 0:
+        special.add(weight_idx)
+    if group_idx >= 0:
+        special.add(group_idx)
+    used_cols = [c for c in range(ncol) if c not in special]
+    feature_names = [header_names[c] for c in used_cols] if header_names \
+        else None
+
+    # ---- pass 1: count + metadata columns + reservoir sample ----
+    target = max(2, int(config.bin_construct_sample_cnt))
+    rng = np.random.RandomState(config.data_random_seed)
+    sample = None
+    n_seen = 0
+    labels, weights, gcols = [], [], []
+    for chunk in _dense_line_chunks(filename, skip, sep, chunk_rows):
+        if 0 <= label_idx < ncol:
+            labels.append(chunk[:, label_idx].copy())
+        if weight_idx >= 0:
+            weights.append(chunk[:, weight_idx].copy())
+        if group_idx >= 0:
+            gcols.append(chunk[:, group_idx].copy())
+        Xc = chunk[:, used_cols]
+        m = len(Xc)
+        if sample is None:
+            sample = np.empty((target, len(used_cols)), np.float64)
+        # vectorized reservoir update: row (n_seen + i) replaces a random
+        # slot with probability target / (n_seen + i + 1)
+        fill = min(max(target - n_seen, 0), m)
+        if fill:
+            sample[n_seen:n_seen + fill] = Xc[:fill]
+        if m > fill:
+            idx = np.arange(n_seen + fill, n_seen + m)
+            r = (rng.random_sample(m - fill) * (idx + 1)).astype(np.int64)
+            keep = r < target
+            sample[r[keep]] = Xc[fill:][keep]
+        n_seen += m
+    if n_seen == 0:
+        Log.fatal("Data file %s is empty", filename)
+    X_sample = sample[:min(target, n_seen)]
+
+    label = np.concatenate(labels) if labels else None
+    weight = np.concatenate(weights) if weights else None
+    group = None
+    if gcols:
+        gc = np.concatenate(gcols).astype(np.int64)
+        change = np.flatnonzero(np.diff(gc)) + 1
+        group = np.diff(np.concatenate([[0], change, [len(gc)]]))
+    qfile = filename + ".query"
+    if group is None and os.path.exists(qfile):
+        group = np.loadtxt(qfile, dtype=np.int64).ravel()
+    wfile = filename + ".weight"
+    if weight is None and os.path.exists(wfile):
+        weight = np.loadtxt(wfile, dtype=np.float64).ravel()
+
+    # structure (bin mappers, EFB, pruning) from the sample
+    ds = construct_dataset(X_sample, config, feature_names=feature_names,
+                           categorical_feature=None)
+    # ---- pass 2: bin row blocks into the final matrix ----
+    ds.num_data = n_seen
+    ds.metadata = Metadata(n_seen, label=label, weight=weight, group=group)
+    out = np.zeros((n_seen, ds.num_groups), dtype=ds.binned.dtype)
+    r0 = 0
+    for chunk in _dense_line_chunks(filename, skip, sep, chunk_rows):
+        Xc = chunk[:, used_cols]
+        out[r0:r0 + len(Xc)] = _extract_binned(
+            Xc, ds, nthreads=int(config.num_threads))
+        r0 += len(Xc)
+    ds.binned = out
+    ds.raw_numeric = None
+    return ds

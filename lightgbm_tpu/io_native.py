@@ -152,7 +152,8 @@ def get_binning_lib() -> Optional[ctypes.CDLL]:
     return lib
 
 
-def apply_bins_native(Xv: np.ndarray, specs, out: np.ndarray) -> bool:
+def apply_bins_native(Xv: np.ndarray, specs, out: np.ndarray,
+                      nthreads: int = 0) -> bool:
     """Bin a batch of numerical features into `out` columns natively.
 
     specs: list of (x_col, upper_bounds f64 array, missing_type,
@@ -173,5 +174,6 @@ def apply_bins_native(Xv: np.ndarray, specs, out: np.ndarray) -> bool:
     lib.lgbm_apply_bins_u8(
         np.ascontiguousarray(Xv), Xv.shape[0], Xv.shape[1],
         np.int32(len(specs)), col_idx, bounds_cat, off, nb, mtype, mbin,
-        out, out.shape[1], ocol, np.int32(os.cpu_count() or 1))
+        out, out.shape[1], ocol,
+        np.int32(nthreads if nthreads > 0 else (os.cpu_count() or 1)))
     return True

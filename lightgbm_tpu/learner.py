@@ -170,7 +170,7 @@ def _set_best(best: SplitInfo, idx, info: SplitInfo) -> SplitInfo:
 
 def _make_best_for(meta: FeatureMeta, hp: SplitHyper, key, feature_mask,
                    num_feat: int, feature_fraction_bynode: float,
-                   extra_trees: bool, constraint_sets):
+                   extra_trees: bool, constraint_sets, extra_seed: int = 6):
     """Shared per-node split evaluation: by-node column sampling,
     extra-trees random thresholds, interaction constraints, then the
     vectorized (F, B) best-split scan."""
@@ -195,7 +195,10 @@ def _make_best_for(meta: FeatureMeta, hp: SplitHyper, key, feature_mask,
             fmask = fmask & (rank < kth)
         rand_thr = None
         if extra_trees:
-            k = jax.random.fold_in(key, r * 2 + 1 + 2000 + leaf)
+            # extra_seed gives the random-threshold stream its own seed
+            # (reference: config.h extra_seed)
+            k = jax.random.fold_in(jax.random.fold_in(key, 2000 + extra_seed),
+                                   r * 2 + 1 + leaf)
             u = jax.random.uniform(k, (num_feat,))
             rand_thr = (u * jnp.maximum(meta.num_bins - 1, 1).astype(jnp.float32)) \
                 .astype(jnp.int32)
@@ -203,7 +206,7 @@ def _make_best_for(meta: FeatureMeta, hp: SplitHyper, key, feature_mask,
 
     def best_for(r, leaf, hist, parent_sum, parent_out, lower, upper,
                  used_row, extra_mask=None, want_feature_gains=False,
-                 use_hp=None, cegb_delta=None):
+                 use_hp=None, cegb_delta=None, node_depth=None):
         fmask, rand_thr = node_inputs(r, leaf)
         fmask = fmask & allowed_mask(used_row)
         if extra_mask is not None:
@@ -212,7 +215,7 @@ def _make_best_for(meta: FeatureMeta, hp: SplitHyper, key, feature_mask,
             hist, parent_sum, meta, fmask, use_hp if use_hp is not None else hp,
             parent_output=parent_out, leaf_lower=lower, leaf_upper=upper,
             rand_threshold=rand_thr, want_feature_gains=want_feature_gains,
-            cegb_delta=cegb_delta)
+            cegb_delta=cegb_delta, node_depth=node_depth)
 
     return best_for
 
@@ -253,7 +256,7 @@ def build_tree(
 
     best_for = _make_best_for(meta, hp, key, feature_mask, num_feat,
                               feature_fraction_bynode, extra_trees,
-                              constraint_sets)
+                              constraint_sets, extra_seed)
 
     # ---- init: root ----
     root_sum = comm.psum(jnp.sum(ghc, axis=0))
@@ -270,7 +273,7 @@ def build_tree(
     best = _empty_best(num_leaves, num_bin)
     best = _set_best(best, 0, best_for(0, jnp.int32(0), root_hist, root_sum,
                                        leaf_out[0], leaf_lower[0], leaf_upper[0],
-                                       leaf_used[0]))
+                                       leaf_used[0], node_depth=jnp.int32(0)))
     row_leaf = jnp.zeros((n,), jnp.int32)
     log = TreeLog(
         num_splits=jnp.int32(0),
@@ -328,7 +331,8 @@ def build_tree(
                     jnp.arange(num_feat) == f_feat[ri], hp,
                     parent_output=leaf_out[fl], leaf_lower=leaf_lower[fl],
                     leaf_upper=leaf_upper[fl],
-                    rand_threshold=jnp.full((num_feat,), f_bin[ri], jnp.int32))
+                    rand_threshold=jnp.full((num_feat,), f_bin[ri], jnp.int32),
+                    node_depth=leaf_depth[fl])
                 ok = fi.gain > -jnp.inf
                 return (jnp.where(ok, fl, leaf),
                         jax.tree.map(lambda a, b: jnp.where(ok, a, b), fi, info),
@@ -402,10 +406,11 @@ def build_tree(
 
         info_l = best_for(r, leaf, hist_left, info.left_sum,
                           leaf_out[leaf], leaf_lower[leaf], leaf_upper[leaf],
-                          used_new)
+                          used_new, node_depth=leaf_depth[leaf])
         info_r = best_for(r, new_leaf, hist_right, info.right_sum,
                           leaf_out[new_leaf], leaf_lower[new_leaf],
-                          leaf_upper[new_leaf], used_new)
+                          leaf_upper[new_leaf], used_new,
+                          node_depth=leaf_depth[new_leaf])
         gate_l = depth_ok(leaf_depth[leaf])
         gate_r = depth_ok(leaf_depth[new_leaf])
         info_l = info_l._replace(gain=jnp.where(gate_l, info_l.gain, -jnp.inf))
@@ -441,6 +446,7 @@ def build_tree_partitioned(
     max_depth: int = -1,
     feature_fraction_bynode: float = 1.0,
     extra_trees: bool = False,
+    extra_seed: int = 6,
     comm: Comm = Comm(),
     hist_chunk: int = 2048,
     part_chunk: int = 2048,
@@ -556,7 +562,7 @@ def build_tree_partitioned(
         fmask_search = feature_mask & owned
     best_raw = _make_best_for(meta, hp, key, fmask_search, num_feat,
                               feature_fraction_bynode, extra_trees,
-                              constraint_sets)
+                              constraint_sets, extra_seed)
     voting = comm.mode == "voting"
     if voting:
         d = float(max(comm.num_machines, 1))
@@ -578,20 +584,22 @@ def build_tree_partitioned(
             + meta.cegb_coupled * (~tree_used).astype(jnp.float32))
 
     def node_best(r, leaf, hg, tot_g, tot_l, parent_out, lower, upper,
-                  used_row, tree_used):
+                  used_row, tree_used, depth):
         """Best split for a node under the active comm strategy. ``hg`` is
         the (bundled) histogram — global for serial/data/feature, LOCAL for
         voting; ``tot_g``/``tot_l`` the node's global/local (g,h,cnt)."""
         delta = cegb_penalty(tot_g, tree_used)
         if not voting:
             info = best_raw(r, leaf, feat_view(hg, tot_g), tot_g, parent_out,
-                            lower, upper, used_row, cegb_delta=delta)
+                            lower, upper, used_row, cegb_delta=delta,
+                            node_depth=depth)
             return comm.sync_split(info)
         # ---- voting parallel (reference: GlobalVoting,
         # voting_parallel_tree_learner.cpp:151,322) ----
         fv_loc = feat_view(hg, tot_l)
         fg = best_raw(r, leaf, fv_loc, tot_l, parent_out, lower, upper,
-                      used_row, want_feature_gains=True, use_hp=hp_loc)
+                      used_row, want_feature_gains=True, use_hp=hp_loc,
+                      node_depth=depth)
         k = min(comm.top_k, num_feat)
         k2 = min(2 * comm.top_k, num_feat)
         _, top_idx = jax.lax.top_k(fg, k)
@@ -608,7 +616,8 @@ def build_tree_partitioned(
         full = (selmat.T @ merged).reshape(fv_loc.shape)       # voted rows only
         selmask = jnp.any(selmat > 0.5, axis=0)
         return best_raw(r, leaf, full, tot_g, parent_out, lower, upper,
-                        used_row, extra_mask=selmask, cegb_delta=delta)
+                        used_row, extra_mask=selmask, cegb_delta=delta,
+                        node_depth=depth)
 
     # ---- init: root ----
     root_sum_loc = jnp.sum(ghc, axis=0)
@@ -637,7 +646,8 @@ def build_tree_partitioned(
     best = _set_best(best, 0,
                      node_best(0, jnp.int32(0), root_hist, root_sum,
                                root_sum_loc, leaf_out[0], leaf_lower[0],
-                               leaf_upper[0], leaf_used[0], tree_used0))
+                               leaf_upper[0], leaf_used[0], tree_used0,
+                               jnp.int32(0)))
     log = TreeLog(
         num_splits=jnp.int32(0),
         split_leaf=jnp.zeros((max_splits,), jnp.int32),
@@ -662,7 +672,7 @@ def build_tree_partitioned(
         return depth < max_depth
 
     node_best_pair = jax.vmap(
-        node_best, in_axes=(None, 0, 0, 0, 0, 0, 0, 0, None, None))
+        node_best, in_axes=(None, 0, 0, 0, 0, 0, 0, 0, None, None, None))
 
     force_live = jnp.bool_(n_forced > 0)
     carry0 = (jnp.int32(0), work, leaf_start, leaf_cnt, leaf_parity,
@@ -703,7 +713,8 @@ def build_tree_partitioned(
                     jnp.arange(num_feat) == f_feat[ri], hp,
                     parent_output=leaf_out[fl], leaf_lower=leaf_lower[fl],
                     leaf_upper=leaf_upper[fl],
-                    rand_threshold=jnp.full((num_feat,), f_bin[ri], jnp.int32))
+                    rand_threshold=jnp.full((num_feat,), f_bin[ri], jnp.int32),
+                    node_depth=leaf_depth[fl])
                 ok = fi.gain > -jnp.inf
                 return (jnp.where(ok, fl, leaf),
                         jax.tree.map(lambda a, b: jnp.where(ok, a, b), fi, info),
@@ -844,7 +855,7 @@ def build_tree_partitioned(
             r, pair, jnp.stack([hist_left, hist_right]),
             jnp.stack([info.left_sum, info.right_sum]),
             jnp.stack([loc_left, loc_right]), leaf_out[pair],
-            leaf_lower[pair], leaf_upper[pair], used_new, tree_used)
+            leaf_lower[pair], leaf_upper[pair], used_new, tree_used, d)
         gates = jnp.stack([depth_ok(leaf_depth[leaf]),
                            depth_ok(leaf_depth[new_leaf])]) & valid
         infos = infos._replace(gain=jnp.where(gates, infos.gain, -jnp.inf))
@@ -1028,6 +1039,7 @@ class SerialTreeLearner:
             has_monotone=dataset.monotone_constraints is not None,
             mono_intermediate=config.monotone_constraints_method
             in ("intermediate", "advanced"),
+            monotone_penalty=float(config.monotone_penalty),
             cegb_tradeoff=float(config.cegb_tradeoff),
             cegb_penalty_split=float(config.cegb_penalty_split),
             # gate on an actually non-zero penalty: cegb_tradeoff alone is a
@@ -1099,6 +1111,7 @@ class SerialTreeLearner:
             max_depth=int(config.max_depth),
             feature_fraction_bynode=float(config.feature_fraction_bynode),
             extra_trees=bool(config.extra_trees),
+            extra_seed=int(config.extra_seed),
             comm=self.comm,
             constraint_sets=self._constraint_sets(),
             forced=self._forced_splits(),

@@ -220,6 +220,19 @@ class AucMuMetric(Metric):
         pred = pred.reshape(-1, K)
         lab = label.astype(np.int64)
         w = np.ones(len(lab)) if weight is None else weight
+        # auc_mu_weights: KxK misclassification-cost matrix defining each
+        # pair's separating direction (reference: multiclass_metric.hpp
+        # AucMuMetric::Eval, Kleiman & Page's AUC-mu: the pair (a, b)
+        # decision value is t1 * <W[a,:] - W[b,:], scores>)
+        if self.config.auc_mu_weights:
+            wm = np.asarray(self.config.auc_mu_weights, np.float64)
+            if wm.size != K * K:
+                from .utils.log import Log
+                Log.fatal("auc_mu_weights must have num_class^2 = %d "
+                          "entries, got %d", K * K, wm.size)
+            wm = wm.reshape(K, K)
+        else:
+            wm = 1.0 - np.eye(K)
         aucs = []
         auc_helper = AUCMetric(self.config)
         for a in range(K):
@@ -227,8 +240,9 @@ class AucMuMetric(Metric):
                 m = (lab == a) | (lab == b)
                 if not np.any(lab[m] == a) or not np.any(lab[m] == b):
                     continue
-                # decision score: difference of the two class probabilities
-                s = pred[m, a] - pred[m, b]
+                curr_v = wm[a] - wm[b]                      # (K,)
+                t1 = curr_v[a] - curr_v[b]
+                s = t1 * (pred[m] @ curr_v)
                 yy = (lab[m] == a).astype(np.float64)
                 aucs.append(auc_helper.eval(s, yy, w[m])[0][1])
         return [(self.name, float(np.mean(aucs)) if aucs else 1.0)]

@@ -66,6 +66,10 @@ class SplitHyper(NamedTuple):
     # split midpoint; intermediate by the sibling's output
     # (reference: monotone_constraints.hpp:327 Basic, :463 Intermediate)
     mono_intermediate: bool = False
+    # gain multiplier for splits on monotone features, decaying with leaf
+    # depth (reference: monotone_constraints.hpp:355
+    # ComputeMonotoneSplitGainPenalty)
+    monotone_penalty: float = 0.0
     # CEGB (reference: cost_effective_gradient_boosting.hpp:66 DetlaGain)
     cegb_tradeoff: float = 1.0
     cegb_penalty_split: float = 0.0
@@ -162,6 +166,7 @@ def find_best_split(
     rand_threshold: Optional[jax.Array] = None,  # (F,) extra-trees random bins
     want_feature_gains: bool = False,
     cegb_delta: Optional[jax.Array] = None,      # (F,) CEGB gain penalties
+    node_depth: Optional[jax.Array] = None,      # scalar i32 leaf depth
 ) -> SplitInfo:
     """Best split over all features for one leaf's histogram.
 
@@ -268,6 +273,19 @@ def find_best_split(
     # ---------- combine ----------
     stacked = jnp.stack([num_gain, oh_gain, mvm_asc, mvm_desc], axis=0)  # (4, F, B)
     stacked = stacked * jnp.where(stacked > NEG_INF, meta.penalty[None, :, None], 1.0)
+    if hp.has_monotone and hp.monotone_penalty > 0 and node_depth is not None:
+        # reference: monotone_constraints.hpp:355 — splits on monotone
+        # features at shallow depths are discounted (and forbidden while
+        # penalization >= depth + 1)
+        p = jnp.float32(hp.monotone_penalty)
+        d = node_depth.astype(jnp.float32)
+        eps = jnp.float32(K_EPSILON)
+        pen = jnp.where(p >= d + 1.0, eps,
+                        jnp.where(p <= 1.0, 1.0 - p / (2.0 ** d) + eps,
+                                  1.0 - 2.0 ** (p - 1.0 - d) + eps))
+        mono_f = meta.monotone != 0
+        stacked = jnp.where(mono_f[None, :, None] & (stacked > NEG_INF),
+                            stacked * pen, stacked)
     if hp.use_cegb and cegb_delta is not None:
         stacked = jnp.where(stacked > NEG_INF,
                             stacked - cegb_delta[None, :, None], stacked)

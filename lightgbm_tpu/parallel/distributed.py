@@ -55,9 +55,13 @@ def init_distributed(coordinator_address: Optional[str] = None,
                  jax.process_index(), jax.process_count(),
                  len(jax.devices()))
     except Exception as e:
-        Log.warning("jax.distributed.initialize failed (%s); continuing "
-                    "single-host with %d local devices", e,
-                    len(jax.local_devices()))
+        # FAIL LOUDLY: a mis-bootstrapped host silently training on its
+        # local devices would run different collectives than its peers
+        # (the reference likewise aborts in Network::Init,
+        # src/network/linkers_socket.cpp, when the cluster is short)
+        Log.fatal("jax.distributed.initialize failed: %s. Fix the "
+                  "coordinator/num_processes/process_id bootstrap or run "
+                  "single-host by not calling init_distributed.", e)
 
 
 @contextmanager

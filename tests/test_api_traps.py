@@ -1,0 +1,89 @@
+"""Regression tests for the round-2 API traps (VERDICT r2 weak #6/#7/#8):
+reset_parameter must preserve the learner class, refit must carry real
+metadata, init_distributed must fail loudly."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.utils.log import LightGBMError
+
+
+def _data(n=1200, f=8, seed=5):
+    rng = np.random.RandomState(seed)
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] + rng.normal(scale=0.3, size=n) > 0).astype(float)
+    return X, y
+
+
+def test_reset_parameter_preserves_mesh_learner():
+    import jax
+    if len(jax.devices()) < 2:
+        pytest.skip("needs a multi-device mesh")
+    from lightgbm_tpu.parallel.mesh import DataParallelTreeLearner
+    X, y = _data()
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.Booster(params={"objective": "binary", "num_leaves": 7,
+                              "tree_learner": "data", "verbose": -1},
+                      train_set=ds)
+    assert isinstance(bst.inner.learner, DataParallelTreeLearner)
+    bst.update()
+    bst.reset_parameter({"learning_rate": 0.02})
+    assert isinstance(bst.inner.learner, DataParallelTreeLearner), \
+        "reset_parameter downgraded the mesh learner to serial"
+    bst.update()  # must keep training without crashing
+    assert bst.current_iteration == 2
+
+
+def test_reset_parameter_refreshes_samplers():
+    X, y = _data()
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.Booster(params={"objective": "binary", "num_leaves": 7,
+                              "bagging_fraction": 0.8, "bagging_freq": 1,
+                              "verbose": -1}, train_set=ds)
+    bst.update()
+    bst.reset_parameter({"bagging_fraction": 0.5})
+    bst.update()
+    assert bst.inner._sampler_fn is not None
+    assert bst.current_iteration == 2
+
+
+def test_refit_weighted():
+    X, y = _data()
+    w = np.linspace(0.5, 2.0, len(y))
+    ds = lgb.Dataset(X, label=y, weight=w)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7, "verbose": -1},
+                    ds, num_boost_round=3)
+    out = bst.refit(X, 1.0 - y, weight=w, decay_rate=0.1)
+    assert out.current_iteration == 3
+    # refitting on flipped labels must move the leaf values
+    assert not np.allclose(out.predict(X), bst.predict(X))
+
+
+def test_refit_ranking_requires_group():
+    rng = np.random.RandomState(0)
+    X = rng.normal(size=(300, 6))
+    y = rng.randint(0, 3, 300).astype(float)
+    group = np.full(10, 30)
+    ds = lgb.Dataset(X, label=y, group=group)
+    bst = lgb.train({"objective": "lambdarank", "num_leaves": 7,
+                     "verbose": -1, "min_data_in_leaf": 5},
+                    ds, num_boost_round=2)
+    with pytest.raises(LightGBMError):
+        bst.refit(X, y)   # no group -> loud failure, not a crash/mis-fit
+    out = bst.refit(X, y, group=group)
+    assert out.current_iteration == 2
+
+
+def test_init_distributed_fails_loudly(monkeypatch):
+    import jax
+    from lightgbm_tpu.parallel import distributed
+
+    def boom(**kw):
+        raise RuntimeError("bootstrap broken")
+
+    monkeypatch.setattr(jax.distributed, "initialize", boom)
+    monkeypatch.setattr(distributed.init_distributed, "_done", False,
+                        raising=False)
+    with pytest.raises(LightGBMError):
+        distributed.init_distributed(coordinator_address="127.0.0.1:9999",
+                                     num_processes=2, process_id=0)
