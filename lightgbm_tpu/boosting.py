@@ -210,6 +210,10 @@ class GBDT:
         if not hasattr(self, "_sampler_fn"):
             from .fused import make_balanced_sampler, make_sampler
             lab = self.objective.label if self.objective is not None else None
+            if lab is None and self.train_set is not None \
+                    and self.train_set.metadata.label is not None:
+                # custom objectives (objective=none) still bag by label
+                lab = jnp.asarray(self.train_set.metadata.label)
             # GOSS takes precedence over any bagging params (the reference's
             # data_sample_strategy switch, gbdt.cpp:228)
             if cfg.data_sample_strategy != "goss" \
@@ -660,6 +664,84 @@ class GBDT:
         except Exception:  # importances are informational; never block IO
             pass
         return "\n".join(lines)
+
+    def to_if_else_cpp(self, num_iteration: int = -1) -> str:
+        """Standalone C++ prediction source for the whole ensemble
+        (reference: gbdt_model_text.cpp:258 ModelToIfElse; also its model-
+        correctness regression harness). Emits per-tree if-else functions,
+        a PredictRaw accumulator (init scores included) and extern-C
+        single-row entry points so the file both drops into user code and
+        compiles into a test harness."""
+        K = self.num_tree_per_iteration
+        total_iters = len(self.models) // max(K, 1)
+        if num_iteration is None or num_iteration <= 0:
+            num_iteration = total_iters
+        end = min(total_iters, num_iteration) * K
+        if any(getattr(t, "is_linear", False) for t in self.models[:end]):
+            Log.fatal("convert_model does not support linear trees "
+                      "(leaf linear terms); disable linear_tree")
+        parts = [
+            "// generated by lightgbm_tpu task=convert_model",
+            "#include <cmath>",
+            "#include <cstdint>",
+            "#include <algorithm>",
+            "",
+            "static inline bool cat_in(int64_t v, const int64_t* arr, "
+            "int n) {",
+            "  return std::binary_search(arr, arr + n, v);",
+            "}",
+            "",
+        ]
+        for i, tree in enumerate(self.models[:end]):
+            parts.append(tree.to_if_else(i))
+            parts.append("")
+        init = ", ".join("%.17g" % v for v in self.init_scores[:max(K, 1)])
+        parts += [
+            "static const int kNumClass = %d;" % max(K, 1),
+            "static const int kNumTrees = %d;" % end,
+            "static const double kInitScore[%d] = {%s};" % (max(K, 1), init),
+            "",
+            "typedef double (*TreeFn)(const double*);",
+            "static const TreeFn kTrees[%d] = {%s};" % (
+                max(end, 1),
+                ", ".join("PredictTree%d" % i for i in range(end)) or "0"),
+            "",
+            "extern \"C\" void PredictRaw(const double* arr, double* out) {",
+            "  for (int k = 0; k < kNumClass; ++k) out[k] = kInitScore[k];",
+            "  for (int i = 0; i < kNumTrees; ++i) {",
+            "    out[i % kNumClass] += kTrees[i](arr);",
+            "  }",
+            "}",
+            "",
+        ]
+        obj = self.objective.name if self.objective else ""
+        if obj == "binary":
+            sig = self.config.sigmoid
+            transform = ("  out[0] = 1.0 / (1.0 + std::exp(-%.17g * "
+                         "out[0]));" % sig)
+        elif obj in ("multiclassova", "ova"):
+            sig = self.config.sigmoid
+            transform = ("  for (int k = 0; k < kNumClass; ++k) out[k] = "
+                         "1.0 / (1.0 + std::exp(-%.17g * out[k]));" % sig)
+        elif obj in ("multiclass", "softmax"):
+            transform = (
+                "  double m = out[0];\n"
+                "  for (int k = 1; k < kNumClass; ++k) m = std::max(m, "
+                "out[k]);\n"
+                "  double s = 0;\n"
+                "  for (int k = 0; k < kNumClass; ++k) { out[k] = "
+                "std::exp(out[k] - m); s += out[k]; }\n"
+                "  for (int k = 0; k < kNumClass; ++k) out[k] /= s;")
+        else:
+            transform = "  // identity output transform"
+        parts += [
+            "extern \"C\" void Predict(const double* arr, double* out) {",
+            "  PredictRaw(arr, out);",
+            transform,
+            "}",
+            "",
+        ]
+        return "\n".join(parts)
 
     def _objective_string(self) -> str:
         obj = self.objective.name if self.objective else self.config.objective

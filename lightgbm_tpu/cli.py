@@ -119,14 +119,21 @@ class Application:
         Log.info("Finished prediction; results saved to %s", cfg.output_result)
 
     def convert_model(self) -> None:
+        """reference: task=convert_model (gbdt_model_text.cpp ModelToIfElse
+        for convert_model_language=cpp; JSON dump otherwise)."""
         cfg = self.config
         if not cfg.input_model:
             Log.fatal("task=convert_model requires input_model")
         bst = Booster(model_file=cfg.input_model)
-        out = getattr(cfg, "convert_model_file", "") or "gbdt_prediction.json"
-        with open(out, "w") as f:
-            f.write(bst.inner.dump_json())
-        Log.info("Model dumped to %s", out)
+        out = cfg.convert_model or "gbdt_prediction.cpp"
+        if cfg.convert_model_language == "cpp":
+            with open(out, "w") as f:
+                f.write(bst.inner.to_if_else_cpp())
+            Log.info("Model converted to C++ source at %s", out)
+        else:
+            with open(out, "w") as f:
+                f.write(bst.inner.dump_json())
+            Log.info("Model dumped to %s", out)
 
     def refit(self) -> None:
         cfg = self.config

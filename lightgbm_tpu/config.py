@@ -126,6 +126,8 @@ class Config:
     verbosity: int = 1
     input_model: str = ""
     output_model: str = "LightGBM_model.txt"
+    convert_model: str = "gbdt_prediction.cpp"
+    convert_model_language: str = "cpp"   # cpp | json
     saved_feature_importance_type: int = 0
     snapshot_freq: int = -1
 
@@ -214,6 +216,9 @@ class Config:
     tpu_partition_kernel: str = "auto"  # auto|pallas|xla: fused Pallas DMA
     #   partition kernel (TPU only) vs the portable XLA op pipeline
     tpu_hist_chunk: int = 2048       # rows per segment-histogram chunk
+    tpu_hist_scatter: bool = True    # data-parallel: reduce-scatter
+    #   histograms by feature-group block + owned-feature search + split
+    #   argmax-sync (vs full psum + replicated search)
     tpu_hist_precision: str = "hilo"  # hilo (~2^-17 rel, bf16 pair) |
     #   bf16 (single bf16 grads) | int8 (quantized training)
     use_quantized_grad: bool = False  # int8 stochastic gradient quantization

@@ -116,6 +116,9 @@ class BinnedDataset:
         # raw numerical feature values, kept only for linear_tree
         # (reference: Dataset::raw_data_, dataset.h numeric_feature_map_)
         self.raw_numeric: Optional[np.ndarray] = None   # (N, F) f32, NaN kept
+        # distributed loading: (rank, world, global_rows) when this object
+        # holds only one host's row shard (io.load_dataset_sharded)
+        self.shard_info: Optional[tuple] = None
 
     # -- accessors used by the learners --
     @property

@@ -78,11 +78,35 @@ class _MeshTreeLearner(SerialTreeLearner):
         self.row_sharding = NamedSharding(mesh, P(DATA_AXIS))
         self.rep_sharding = NamedSharding(mesh, P())
         if self.rows_sharded:
-            self.padded_n = round_up(n, d)
-            bins_np = np.asarray(dataset.binned)
-            if self.padded_n != n:
-                bins_np = np.pad(bins_np, ((0, self.padded_n - n), (0, 0)))
-            self.bins = jax.device_put(jnp.asarray(bins_np), self.row_sharding)
+            shard = getattr(dataset, "shard_info", None)
+            if shard is not None and jax.process_count() > 1:
+                # distributed loading: every process holds only its row
+                # shard; assemble the global sharded array without any host
+                # ever materializing the full matrix (reference analog: the
+                # per-rank partitions of dataset_loader.cpp:951)
+                rank, world, n_total = shard
+                if world != jax.process_count():
+                    Log.fatal("dataset was sharded for %d processes but "
+                              "%d are running", world, jax.process_count())
+                self.padded_n = round_up(n_total, d)
+                local = np.asarray(dataset.binned)
+                per_proc = self.padded_n // world
+                if len(local) != per_proc:
+                    pad_rows = per_proc - len(local)
+                    if pad_rows < 0:
+                        Log.fatal("shard %d has %d rows > %d per-process "
+                                  "capacity", rank, len(local), per_proc)
+                    local = np.pad(local, ((0, pad_rows), (0, 0)))
+                self.bins = jax.make_array_from_process_local_data(
+                    self.row_sharding, local)
+            else:
+                self.padded_n = round_up(n, d)
+                bins_np = np.asarray(dataset.binned)
+                if self.padded_n != n:
+                    bins_np = np.pad(bins_np,
+                                     ((0, self.padded_n - n), (0, 0)))
+                self.bins = jax.device_put(jnp.asarray(bins_np),
+                                           self.row_sharding)
             row_spec = P(DATA_AXIS)
         else:
             self.padded_n = n
@@ -105,20 +129,46 @@ class _MeshTreeLearner(SerialTreeLearner):
     def _make_comm(self, axis: Optional[str]) -> Comm:
         return Comm(axis, mode=self.comm_mode,
                     top_k=int(self.config.top_k),
-                    num_machines=int(self.mesh.devices.size))
+                    num_machines=int(self.mesh.devices.size),
+                    hist_scatter=bool(self.config.tpu_hist_scatter))
 
     def train(self, ghc: jax.Array, feature_mask: jax.Array, key: jax.Array,
               cegb_used=None) -> TreeLog:
         n = self.dataset.num_data
         if cegb_used is None:
             cegb_used = jnp.zeros((self.dataset.num_features,), bool)
-        if self.rows_sharded and self.padded_n != n:
+        shard = getattr(self.dataset, "shard_info", None)
+        multiproc = self.rows_sharded and shard is not None \
+            and jax.process_count() > 1
+        if multiproc:
+            # the dataset holds only this process's rows: gradients must be
+            # assembled the same way the bins were — each process
+            # contributes its LOCAL rows to the global row-sharded array
+            # (device_put would instead scatter the local array as if it
+            # were the global one, pairing rank>0 bins with garbage)
+            per_proc = self.padded_n // shard[1]
+            loc = np.asarray(ghc)
+            if len(loc) != per_proc:
+                loc = np.pad(loc, ((0, per_proc - len(loc)), (0, 0)))
+            ghc = jax.make_array_from_process_local_data(
+                self.row_sharding, loc)
+        elif self.rows_sharded and self.padded_n != n:
             ghc = jnp.pad(ghc, ((0, self.padded_n - n), (0, 0)))
         sharding = self.row_sharding if self.rows_sharded else self.rep_sharding
-        ghc = jax.device_put(ghc, sharding)
+        if not multiproc:
+            ghc = jax.device_put(ghc, sharding)
         log = self._build(self.bins, ghc, self.meta, feature_mask, key,
                           cegb_used)
-        if self.rows_sharded and self.padded_n != n:
+        if multiproc:
+            # row_leaf comes back globally sharded; a non-addressable global
+            # array cannot be sliced on host — gather this process's
+            # addressable shards and trim the padding
+            rows = np.concatenate(
+                [np.asarray(sh.data)
+                 for sh in sorted(log.row_leaf.addressable_shards,
+                                  key=lambda sh: sh.index[0].start or 0)])
+            log = log._replace(row_leaf=jnp.asarray(rows[:n]))
+        elif self.rows_sharded and self.padded_n != n:
             log = log._replace(row_leaf=log.row_leaf[:n])
         return log
 

@@ -169,6 +169,67 @@ class Tree:
             return np.where(np.abs(v) <= 1e-35, default_left, base)
         return base
 
+    def to_if_else(self, index: int) -> str:
+        """Emit this tree as a standalone C++ if-else function
+        (reference: gbdt_model_text.cpp:258 GBDT::ModelToIfElse — the
+        reference also uses the generated code as a prediction regression
+        harness; tests/test_codegen.py does the same here).
+
+        Decision semantics mirror _decide: None/Zero missing treats NaN as
+        0.0 (Zero additionally routes |x|<=1e-35 to the default side);
+        NaN-aware splits route NaN to the default side.
+        """
+        lines = ["double PredictTree%d(const double* arr) {" % index]
+        if self.num_leaves <= 1:
+            lines.append("  return %.17g;" % float(self.leaf_value[0]))
+            lines.append("}")
+            return "\n".join(lines)
+
+        def emit(node: int, ind: str, out):
+            if node < 0:
+                out.append("%sreturn %.17g;"
+                           % (ind, float(self.leaf_value[~node])))
+                return
+            f = int(self.split_feature[node])
+            dt = int(self.decision_type[node])
+            if dt & K_CATEGORICAL_MASK:
+                cats = self.cat_threshold.get(
+                    node, np.array([], dtype=np.int64))
+                cond = ("!std::isnan(arr[%d]) && cat_in((int64_t)arr[%d], "
+                        "kCats%d_%d, %d)" % (f, f, index, node, len(cats)))
+                out.append("%sif (%s) {" % (ind, cond))
+            else:
+                thr = float(self.threshold[node])
+                missing_type = (dt >> 2) & 3
+                default_left = bool(dt & K_DEFAULT_LEFT_MASK)
+                if missing_type == 2:
+                    cond = "std::isnan(arr[%d]) ? %s : (arr[%d] <= %.17g)" \
+                        % (f, "true" if default_left else "false", f, thr)
+                elif missing_type == 1:
+                    cond = ("[&]{ double v = std::isnan(arr[%d]) ? 0.0 : "
+                            "arr[%d]; return std::fabs(v) <= 1e-35 ? %s : "
+                            "(v <= %.17g); }()"
+                            % (f, f, "true" if default_left else "false",
+                               thr))
+                else:
+                    cond = ("(std::isnan(arr[%d]) ? 0.0 : arr[%d]) <= %.17g"
+                            % (f, f, thr))
+                out.append("%sif (%s) {" % (ind, cond))
+            emit(int(self.left_child[node]), ind + "  ", out)
+            out.append("%s} else {" % ind)
+            emit(int(self.right_child[node]), ind + "  ", out)
+            out.append("%s}" % ind)
+
+        # category tables for this tree
+        pre = []
+        for node, cats in sorted(self.cat_threshold.items()):
+            pre.append("static const int64_t kCats%d_%d[%d] = {%s};"
+                       % (index, node, max(len(cats), 1),
+                          ", ".join(str(int(c)) for c in cats) or "0"))
+        body: list = []
+        emit(0, "  ", body)
+        return "\n".join(pre + lines + body + ["}"])
+
     def predict(self, X: np.ndarray) -> np.ndarray:
         """Batch prediction of leaf outputs for raw feature rows."""
         X = np.asarray(X, dtype=np.float64)

@@ -1,0 +1,100 @@
+"""convert_model C++ codegen must predict EXACTLY like the python model.
+
+The reference treats its generated if-else code as a model-correctness
+regression harness (tests/cpp_test on gbdt_model_text.cpp ToIfElse); here
+the generated source is compiled with g++ and driven through ctypes.
+"""
+import ctypes
+import os
+import shutil
+import subprocess
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+needs_gxx = pytest.mark.skipif(shutil.which("g++") is None,
+                               reason="g++ not available")
+
+
+def _compile(src_path, tmp_path):
+    so = os.path.join(tmp_path, "model.so")
+    r = subprocess.run(["g++", "-O2", "-shared", "-fPIC", "-std=c++14",
+                        "-o", so, src_path],
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stderr[-2000:]
+    lib = ctypes.CDLL(so)
+    dptr = np.ctypeslib.ndpointer(dtype=np.float64, flags="C_CONTIGUOUS")
+    for fn in ("PredictRaw", "Predict"):
+        getattr(lib, fn).argtypes = [dptr, dptr]
+        getattr(lib, fn).restype = None
+    return lib
+
+
+def _predict_all(lib, X, k, raw=False):
+    out = np.zeros(k)
+    res = np.zeros((len(X), k))
+    fn = lib.PredictRaw if raw else lib.Predict
+    for i, row in enumerate(np.ascontiguousarray(X, np.float64)):
+        fn(row, out)
+        res[i] = out
+    return res
+
+
+@needs_gxx
+def test_binary_codegen_exact(tmp_path):
+    rng = np.random.RandomState(0)
+    X = rng.normal(size=(1200, 6))
+    X[rng.rand(1200, 6) < 0.05] = np.nan          # exercise missing handling
+    y = (np.nansum(X[:, :2], axis=1) > 0).astype(float)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15, "verbose": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=8)
+    src = tmp_path / "model.cpp"
+    src.write_text(bst.inner.to_if_else_cpp())
+    lib = _compile(str(src), str(tmp_path))
+    # raw scores are pure f64 on both sides: exact
+    raw = _predict_all(lib, X[:300], 1, raw=True)[:, 0]
+    np.testing.assert_allclose(raw, bst.predict(X[:300], raw_score=True),
+                               rtol=0, atol=1e-10)
+    # the python transform runs in f32 on device; allow that rounding
+    got = _predict_all(lib, X[:300], 1)[:, 0]
+    np.testing.assert_allclose(got, bst.predict(X[:300]), atol=2e-6)
+
+
+@needs_gxx
+def test_multiclass_codegen_exact(tmp_path):
+    rng = np.random.RandomState(1)
+    X = rng.normal(size=(1500, 5))
+    y = np.digitize(X[:, 0], [-0.5, 0.5]).astype(float)
+    bst = lgb.train({"objective": "multiclass", "num_class": 3,
+                     "num_leaves": 15, "verbose": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=5)
+    src = tmp_path / "model.cpp"
+    src.write_text(bst.inner.to_if_else_cpp())
+    lib = _compile(str(src), str(tmp_path))
+    raw = _predict_all(lib, X[:200], 3, raw=True)
+    np.testing.assert_allclose(raw, bst.predict(X[:200], raw_score=True),
+                               rtol=0, atol=1e-10)
+    got = _predict_all(lib, X[:200], 3)
+    np.testing.assert_allclose(got, bst.predict(X[:200]), atol=2e-6)
+
+
+@needs_gxx
+def test_categorical_codegen_exact(tmp_path):
+    rng = np.random.RandomState(2)
+    n = 1500
+    Xc = rng.randint(0, 8, size=(n, 1)).astype(np.float64)
+    Xn = rng.normal(size=(n, 3))
+    X = np.column_stack([Xc, Xn])
+    y = ((Xc[:, 0] % 3 == 0) ^ (Xn[:, 0] > 0)).astype(float)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15, "verbose": -1,
+                     "categorical_feature": [0], "min_data_per_group": 5,
+                     "cat_smooth": 1.0},
+                    lgb.Dataset(X, label=y), num_boost_round=6)
+    src = tmp_path / "model.cpp"
+    src.write_text(bst.inner.to_if_else_cpp())
+    lib = _compile(str(src), str(tmp_path))
+    raw = _predict_all(lib, X[:300], 1, raw=True)[:, 0]
+    np.testing.assert_allclose(raw, bst.predict(X[:300], raw_score=True),
+                               rtol=0, atol=1e-10)

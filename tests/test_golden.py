@@ -1,0 +1,108 @@
+"""Cross-round golden quality fixtures (VERDICT r2 #9).
+
+Pins per-iteration metric curves on the reference's real example datasets
+so performance work between rounds cannot silently trade model quality.
+The golden values were recorded from the round-3 code (deterministic: the
+builders and seed-derived samplers produce identical models per config on
+a fixed dataset) and carry a small tolerance for cross-backend float
+reassociation. Regenerate ONLY after an intentional algorithm change:
+    python tests/test_golden.py --regen
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io import load_text_file
+
+EX = "/root/reference/examples"
+GOLDEN = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "golden_curves.json")
+TOL = 2e-3   # absolute per-point metric tolerance
+
+pytestmark = pytest.mark.skipif(not os.path.isdir(EX),
+                                reason="reference examples not mounted")
+
+
+def _data(subdir, fname):
+    cfg = Config.from_params({"verbosity": -1})
+    X, y, w, grp, _ = load_text_file(os.path.join(EX, subdir, fname), cfg)
+    return X, y, w, grp
+
+
+def _curve(record_env):
+    out = {}
+    for (name, metric), vals in record_env.items():
+        out["%s:%s" % (name, metric)] = vals
+    return out
+
+
+def _run_binary():
+    X, y, _, _ = _data("binary_classification", "binary.train")
+    ds = lgb.Dataset(X, label=y)
+    rec = {}
+    lgb.train({"objective": "binary", "num_leaves": 31, "learning_rate": 0.1,
+               "metric": ["auc", "binary_logloss"], "verbose": -1}, ds,
+              num_boost_round=20, valid_sets=[ds], valid_names=["training"],
+              callbacks=[lgb.record_evaluation(rec)])
+    return {"binary:%s" % k: v for k, v in rec["training"].items()}
+
+
+def _run_multiclass():
+    X, y, _, _ = _data("multiclass_classification", "multiclass.train")
+    ds = lgb.Dataset(X, label=y)
+    rec = {}
+    lgb.train({"objective": "multiclass", "num_class": 5, "num_leaves": 31,
+               "learning_rate": 0.05, "metric": ["multi_logloss"],
+               "verbose": -1}, ds, num_boost_round=15, valid_sets=[ds],
+              valid_names=["training"],
+              callbacks=[lgb.record_evaluation(rec)])
+    return {"multiclass:%s" % k: v for k, v in rec["training"].items()}
+
+
+def _run_lambdarank():
+    X, y, _, grp = _data("lambdarank", "rank.train")
+    ds = lgb.Dataset(X, label=y, group=grp)
+    rec = {}
+    lgb.train({"objective": "lambdarank", "num_leaves": 31,
+               "learning_rate": 0.1, "metric": ["ndcg"], "eval_at": [10],
+               "verbose": -1}, ds, num_boost_round=15, valid_sets=[ds],
+              valid_names=["training"],
+              callbacks=[lgb.record_evaluation(rec)])
+    return {"lambdarank:%s" % k: v for k, v in rec["training"].items()}
+
+
+def _collect():
+    out = {}
+    out.update(_run_binary())
+    out.update(_run_multiclass())
+    out.update(_run_lambdarank())
+    return out
+
+
+@pytest.mark.skipif(not os.path.exists(GOLDEN),
+                    reason="golden_curves.json not recorded yet")
+def test_metric_curves_match_golden():
+    with open(GOLDEN) as f:
+        golden = json.load(f)
+    got = _collect()
+    assert set(got) == set(golden), (sorted(got), sorted(golden))
+    for key, want in golden.items():
+        have = got[key]
+        assert len(have) == len(want), key
+        diffs = np.abs(np.asarray(have) - np.asarray(want))
+        assert float(diffs.max()) <= TOL, \
+            "%s drifted: max |delta|=%.2e (tol %.0e)\nwant %s\ngot  %s" % (
+                key, diffs.max(), TOL, want[:5], have[:5])
+
+
+if __name__ == "__main__":
+    import sys
+    if "--regen" in sys.argv:
+        curves = _collect()
+        with open(GOLDEN, "w") as f:
+            json.dump(curves, f, indent=1)
+        print("wrote", GOLDEN, "with", len(curves), "curves")

@@ -54,14 +54,16 @@ def test_noop_params_warn(capsys):
         Config.from_params({name: value})
         err = capsys.readouterr().err + capsys.readouterr().out
         # Log may write to stdout; check both
-    # spot-check one concrete warning text end-to-end
-    import io
+    # spot-check one concrete warning text end-to-end (restore the level:
+    # earlier tests may have trained with verbosity=-1, which suppresses
+    # warnings below the callback)
     from lightgbm_tpu.utils.log import Log
     msgs = []
-    old = Log.reset_callback(lambda m: msgs.append(m)) \
-        if hasattr(Log, "reset_callback") else None
-    Config.from_params({"force_row_wise": True})
-    if old is not None:
+    Log.reset_log_level(Log.WARNING)
+    Log.reset_callback(msgs.append)
+    try:
+        Config.from_params({"force_row_wise": True})
+    finally:
         Log.reset_callback(None)
     assert any("force_row_wise" in m for m in msgs)
 
