@@ -215,7 +215,8 @@ class Config:
     #   (0 = auto: 1024 for the fused pallas kernel, 2048 for the XLA path)
     tpu_partition_kernel: str = "auto"  # auto|pallas|xla: fused Pallas DMA
     #   partition kernel (TPU only) vs the portable XLA op pipeline
-    tpu_hist_chunk: int = 2048       # rows per segment-histogram chunk
+    tpu_hist_chunk: int = 0          # rows per segment-histogram chunk
+    #   (0 = auto: 4096 for narrow matrices, 2048 for wide ones)
     tpu_hist_scatter: bool = True    # data-parallel: reduce-scatter
     #   histograms by feature-group block + owned-feature search + split
     #   argmax-sync (vs full psum + replicated search)
