@@ -279,6 +279,7 @@ def build_tree(
     forced: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None,
     # forced = (leaf (R,), feature (R,), bin (R,)) BFS-ordered forced splits
     mxu_bf16: bool = False,
+    extra_seed: int = 6,
 ) -> TreeLog:
     """Grow one leaf-wise tree entirely on device. jit/shard_map once."""
     n, num_feat = bins.shape
