@@ -450,6 +450,12 @@ class GBDT:
             self._fused = FusedTrainer(self)
         return self._fused.run(k)
 
+    def finish_fused(self) -> bool:
+        """Finalize any in-flight fused block (host trees + cegb state)."""
+        if getattr(self, "_fused", None) is None:
+            return False
+        return self._fused.flush()
+
     def rollback_one_iter(self) -> None:
         """(reference: gbdt.cpp:454 RollbackOneIter)"""
         if self.iter_ <= 0:

@@ -93,14 +93,30 @@ def train(
             and not user_callbacks and booster.inner.supports_fused()):
         block = max(1, int(params.get("tpu_iter_block", 10)))
         end = begin + num_boost_round
-        while booster.inner.iter_ < end:
-            k = min(block, end - booster.inner.iter_)
-            with global_timer.timed("fused boosting block"):
-                stopped = booster.inner.train_block(k)
-            if stopped:
-                Log.warning("Stopped training because there are no more leaves "
-                            "that meet the split requirements")
-                break
+        stopped = False
+        scheduled = begin  # iter_ lags by the in-flight pipelined block
+        try:
+            while scheduled < end:
+                k = min(block, end - scheduled)
+                with global_timer.timed("fused boosting block"):
+                    stopped = booster.inner.train_block(k)
+                if stopped:
+                    break
+                scheduled += k
+        except BaseException:
+            # best-effort cleanup; never mask the primary error
+            try:
+                booster.inner.finish_fused()
+            except BaseException:
+                pass
+            raise
+        else:
+            # the fused path pipelines host tree reconstruction one block
+            # behind the device; finalize the in-flight block
+            stopped = booster.inner.finish_fused() or stopped
+        if stopped:
+            Log.warning("Stopped training because there are no more leaves "
+                        "that meet the split requirements")
         booster.best_iteration = booster.inner.iter_
         booster.inner.best_iteration = booster.best_iteration
         return booster

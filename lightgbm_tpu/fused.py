@@ -213,6 +213,10 @@ class FusedTrainer:
                  or cfg.neg_bagging_fraction < 1.0)
             and cfg.bagging_freq > 0 and gbdt.objective.label is not None)
         self.num_feat = gbdt.train_set.num_features
+        # pipeline state: the dispatched-but-not-finalized block and the
+        # device-resident cegb feature-used mask
+        self._pending = None
+        self._cegb_used_dev = None
 
     def _fingerprint(self, k: int) -> tuple:
         """Everything that shapes the traced block computation but is not a
@@ -336,35 +340,103 @@ class FusedTrainer:
     def run(self, k: int) -> bool:
         """Run k fused iterations. Returns True when training should stop.
 
-        Every tree the block computed is kept (constant trees contributed
-        zero score in-graph via the num_splits mask), so model and score
-        stay consistent for rollback/continued training; stopping is
-        signalled when the final iteration grew no real tree — matching
-        train_one_iter's all-constant criterion."""
+        Pipelined: the device block is dispatched (async) and the PREVIOUS
+        block's host-side work — the blocking logs transfer and per-tree
+        reconstruction (~80 ms/iter on a 1-core host) — happens while the
+        new block executes on device. The returned stop signal therefore
+        refers to the previous block; when it fires, the in-flight block's
+        state is rolled back so the model matches the non-pipelined
+        semantics exactly (training stops at the first all-constant
+        iteration; reference: gbdt.cpp:379 "no more leaves"). Callers must
+        invoke :meth:`flush` when the training loop ends.
+
+        Every tree a kept block computed is appended (constant trees
+        contributed zero score in-graph via the num_splits mask), so model
+        and score stay consistent for rollback/continued training."""
         gbdt = self.gbdt
         fn = self._block_fn(k)
-        it0 = gbdt.iter_
-        import jax.numpy as _jnp
-        (score, used), logs = fn(gbdt.train_score.score,
-                                 _jnp.asarray(gbdt._cegb_used),
+        prev = self._pending
+        # iter_ only advances when a block is FINALIZED (keeps iter_ and
+        # models consistent if finalization fails); schedule from iter_ plus
+        # the not-yet-finalized block's length
+        it0 = gbdt.iter_ + (prev[1] if prev is not None else 0)
+        pre_score = gbdt.train_score.score
+        pre_used = self._used_dev()
+        (score, used), logs = fn(pre_score, pre_used,
                                  gbdt._key, jnp.int32(it0),
                                  self.learner.bins, self.learner.meta,
                                  _obj_array_state(gbdt.objective))
         gbdt.train_score.score = score
-        gbdt._cegb_used = np.asarray(used)
-        host = jax.device_get(logs)
+        self._cegb_used_dev = used
+        # pre_score/pre_used ride along for the rollback paths below
+        self._pending = (logs, k, pre_score, pre_used)
+        stopped = self._finalize(prev)
+        if stopped:
+            # previous block ended all-constant: drop the in-flight block
+            # (its trees would all be constant too, but the reference model
+            # stops at the first all-constant iteration)
+            self._rollback(pre_score, pre_used)
+        return stopped
+
+    def _used_dev(self) -> jax.Array:
+        dev = self._cegb_used_dev
+        if dev is None:
+            dev = jnp.asarray(self.gbdt._cegb_used)
+        return dev
+
+    def _rollback(self, pre_score, pre_used) -> None:
+        """Drop the in-flight block and restore pre-block device state."""
+        self.gbdt.train_score.score = pre_score
+        self._cegb_used_dev = pre_used
+        self._pending = None
+
+    def flush(self) -> bool:
+        """Finalize the in-flight block (if any) and sync host-side state.
+        Returns True when the finalized block ended all-constant."""
+        pending = self._pending
+        self._pending = None
+        try:
+            stopped = self._finalize(pending)
+        finally:
+            dev = self._cegb_used_dev
+            if dev is not None:
+                try:
+                    self.gbdt._cegb_used = np.asarray(dev)
+                    self._cegb_used_dev = None
+                except Exception:
+                    pass  # device errors surface from _finalize instead
+        return stopped
+
+    def _finalize(self, pending) -> bool:
+        """Append a dispatched block's trees and advance iter_. On failure
+        (device error, interrupt during the transfer or the host tree loop)
+        the booster rolls back to its last finalized state: score/used
+        revert to the block's inputs, no partial trees are kept, and any
+        in-flight successor block is dropped."""
+        if pending is None:
+            return False
+        logs, k, pre_score, pre_used = pending
+        gbdt = self.gbdt
         K = gbdt.num_tree_per_iteration
         last_iter_constant = False
-        for i in range(k):
-            all_constant = True
-            for c in range(K):
-                pick = (lambda a: a[i, c] if K > 1 else a[i])
-                tree = self._host_tree(host, pick)
-                tree.apply_shrinkage(float(self.config.learning_rate))
-                gbdt.models.append(tree)
-                if tree.num_leaves > 1:
-                    all_constant = False
-            last_iter_constant = all_constant
+        trees = []
+        try:
+            host = jax.device_get(logs)
+            for i in range(k):
+                all_constant = True
+                for c in range(K):
+                    pick = (lambda a: a[i, c] if K > 1 else a[i])
+                    tree = self._host_tree(host, pick)
+                    tree.apply_shrinkage(float(self.config.learning_rate))
+                    trees.append(tree)
+                    if tree.num_leaves > 1:
+                        all_constant = False
+                last_iter_constant = all_constant
+        except BaseException:
+            self._rollback(pre_score, pre_used)
+            raise
+        # atomic commit: models/iter_ move together only on full success
+        gbdt.models.extend(trees)
         gbdt.iter_ += k
         return last_iter_constant
 

@@ -950,13 +950,19 @@ def assign_leaves(bins: jax.Array, log: TreeLog,
     n = bins.shape[0]
     max_splits = log.split_leaf.shape[0]
     row_leaf = jnp.zeros((n,), jnp.int32)
+    # one transpose up front: each routing round then reads ONE contiguous
+    # (N,) row instead of gathering a strided column from the row-major
+    # matrix (the column gather re-streams the whole matrix per round —
+    # measured ~30 ms/tree at 2M x 28; transposed rounds are ~6 ms total)
+    bins_t = bins.T
 
     def body(r, row_leaf):
         active = r < log.num_splits
         leaf = log.split_leaf[r]
         fid = log.feature[r]
         col_idx = bundle["group"][fid] if bundle is not None else fid
-        col = jnp.take(bins, col_idx, axis=1).astype(jnp.int32)
+        col = jax.lax.dynamic_index_in_dim(
+            bins_t, col_idx, axis=0, keepdims=False).astype(jnp.int32)
 
         def go_numerical(col):
             if bundle is not None:
