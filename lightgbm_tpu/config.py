@@ -216,7 +216,10 @@ class Config:
     tpu_partition_kernel: str = "auto"  # auto|pallas|xla: fused Pallas DMA
     #   partition kernel (TPU only) vs the portable XLA op pipeline
     tpu_hist_chunk: int = 0          # rows per segment-histogram chunk
-    #   (0 = auto: 4096 for narrow matrices, 2048 for wide ones)
+    #   (0 = auto: 4096 for narrow matrices, 1024 for wide ones)
+    tpu_hist_lo: int = 0             # hi/lo split width of the histogram
+    #   einsum factorization (0 = auto: 4 for narrow matrices, 8 for wide;
+    #   all widths are bit-identical — this is a pure layout knob)
     tpu_hist_scatter: bool = True    # data-parallel: reduce-scatter
     #   histograms by feature-group block + owned-feature search + split
     #   argmax-sync (vs full psum + replicated search)
@@ -271,6 +274,9 @@ class Config:
             Log.fatal("GOSS requires top_rate + other_rate <= 1.0")
         if self.objective in ("multiclass", "multiclassova", "softmax", "ova") and self.num_class <= 1:
             Log.fatal("num_class must be > 1 for multiclass objectives")
+        if self.tpu_hist_lo not in (0, 2, 4, 8, 16):
+            Log.fatal("tpu_hist_lo must be one of 0 (auto), 2, 4, 8, 16; "
+                      "got %d", self.tpu_hist_lo)
         warned = getattr(self, "_noop_warned", None)
         if warned is None:
             warned = set()

@@ -491,6 +491,7 @@ def build_tree_partitioned(
     hist_chunk: int = 2048,
     part_chunk: int = 2048,
     hist_mode: str = "hilo",  # hilo (bf16-pair) | bf16 | int8 (quantized)
+    hist_lo: int = 0,         # hi/lo einsum split width (0 = auto by F)
     num_bin_hist: Optional[int] = None,   # bundled-column bins (defaults num_bin)
     bundle: Optional[dict] = None,        # EFB maps (dataset.bundle_maps)
     constraint_sets: Optional[jax.Array] = None,   # (S, F) bool
@@ -559,11 +560,11 @@ def build_tree_partitioned(
         if quantized:
             h = hist16_segment_q(work, plane, start, cnt, gscale, hscale,
                                  num_bins=bm, num_feat=num_grp,
-                                 chunk=hist_chunk)
+                                 chunk=hist_chunk, lo_w=hist_lo)
         else:
             h = hist16_segment(work, plane, start, cnt, num_bins=bm,
                                num_feat=num_grp, exact=hist_mode != "bf16",
-                               chunk=hist_chunk)
+                               chunk=hist_chunk, lo_w=hist_lo)
         return comm.hist(h)                               # (G, Bm, 3)
 
     def feat_view(hg, total_sum):
@@ -1204,13 +1205,15 @@ class SerialTreeLearner:
                           "pallas partition kernel (got %d)", part_chunk)
             hist_chunk = int(config.tpu_hist_chunk)
             if hist_chunk <= 0:
-                # measured on v5e: 4096-row chunks win ~3% at F<=64; at
-                # F=137 the einsum operands spill VMEM and cost ~40%
-                hist_chunk = 4096 if self.bins.shape[1] <= 64 else 2048
+                # measured on v5e (lo_w-tuned einsum): 4096-row chunks win
+                # at F<=64; wide matrices spill VMEM — 1024 is ~8% faster
+                # than 2048 at F=137
+                hist_chunk = 4096 if self.bins.shape[1] <= 64 else 1024
             kw.update(
                 hist_chunk=hist_chunk,
                 part_chunk=part_chunk,
                 hist_mode=mode,
+                hist_lo=int(config.tpu_hist_lo),
                 num_bin_hist=self.num_bin_hist,
                 bundle=self.bundle,
                 part_kernel=part_kernel,

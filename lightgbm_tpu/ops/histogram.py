@@ -120,16 +120,25 @@ def build_histogram_jit(bins, ghc, num_bins: int, chunk: int = DEFAULT_CHUNK,
 # exactly the child's segment — the reference's O(rows_in_leaf) contract
 # (dense_bin.hpp:98). The direct one-hot matmul wastes the MXU (3-wide
 # output) and materializes (rows, F*B) one-hots; instead the bin id is
-# decomposed b = 16*hi + lo and the histogram factorizes as
+# decomposed b = lo_w*hi + lo and the histogram factorizes as
 #   H[f,hi,lo,c] = sum_n HiOH[n,f,hi] * (LoOH[n,f,lo] * ch[n,c])
-# — a feature-batched einsum whose operands are (rows, F, 16) and
-# (rows, F, 16*NCH): ~B/16 = 16x less materialization than the direct form
-# (measured ~2-3x faster end to end on v5e, bounded by the VPU one-hot
-# build). Exactness: bf16 (hi, lo) channel splits make every product
+# — a feature-batched einsum whose operands are (rows, F, B/lo_w) and
+# (rows, F, lo_w*NCH): far less materialization than the direct form.
+# The split width trades the two operands against each other AND shapes
+# the per-feature matmul (M = B/lo_w — larger M tiles the MXU better):
+# measured on v5e at B=256, full-N segments: lo_w=16 -> 8 -> 4 runs
+# 22.2 / 14.1 / 10.3 ms at (2M, F=28) and 29.3 / 11.4 / 12.5 ms at
+# (500K, F=137); lo_w=2 collapses (~105 ms). Auto choice: 4 for F <= 64,
+# 8 above. Exactness: bf16 (hi, lo) channel splits make every product
 # exactly representable; the MXU accumulates f32 — the reference's GPU
-# f32-histogram precedent (docs/GPU-Performance.rst).
+# f32-histogram precedent (docs/GPU-Performance.rst); all widths are
+# bit-identical.
 
-LO_W = 16
+LO_W = 16  # legacy default for callers that don't pick per-shape
+
+
+def auto_lo_w(num_feat: int) -> int:
+    return 4 if num_feat <= 64 else 8
 
 
 def _split_bf16(x):
@@ -148,15 +157,18 @@ def _mxu_dtype():
         else jnp.float32
 
 
-def _hist16_chunk(cb, cgm, num_bins: int, exact: bool):
-    """(C, F) u8 + (C, 3) f32 masked channels -> (F, SH, 16*NCH) f32."""
+_LO_SHIFT = {2: 1, 4: 2, 8: 3, 16: 4}
+
+
+def _hist16_chunk(cb, cgm, num_bins: int, exact: bool, lo_w: int = LO_W):
+    """(C, F) u8 + (C, 3) f32 masked channels -> (F, SH, lo_w*NCH) f32."""
     dt = _mxu_dtype()
-    sh = (num_bins + LO_W - 1) // LO_W
-    hi = (cb >> 4).astype(jnp.uint8)
-    lo = (cb & 15).astype(jnp.uint8)
+    sh = (num_bins + lo_w - 1) // lo_w
+    hi = (cb >> _LO_SHIFT[lo_w]).astype(jnp.uint8)
+    lo = (cb & (lo_w - 1)).astype(jnp.uint8)
     hi_oh = (hi[:, :, None] == jnp.arange(sh, dtype=jnp.uint8)) \
         .astype(dt)                                          # (C, F, SH)
-    lo_oh = (lo[:, :, None] == jnp.arange(LO_W, dtype=jnp.uint8))
+    lo_oh = (lo[:, :, None] == jnp.arange(lo_w, dtype=jnp.uint8))
     if exact:
         g_hi, g_lo = _split_bf16(cgm[:, 0])
         h_hi, h_lo = _split_bf16(cgm[:, 1])
@@ -167,43 +179,44 @@ def _hist16_chunk(cb, cgm, num_bins: int, exact: bool):
     nch = ch.shape[1]
     c, f = cb.shape
     log_ = (lo_oh[:, :, :, None].astype(dt)
-            * ch[:, None, None, :].astype(dt)).reshape(c, f, LO_W * nch)
+            * ch[:, None, None, :].astype(dt)).reshape(c, f, lo_w * nch)
     return jnp.einsum("cfh,cfx->fhx", hi_oh, log_,
                       preferred_element_type=jnp.float32)
 
 
-def _hist16_combine(acc, num_bins: int, exact: bool):
+def _hist16_combine(acc, num_bins: int, exact: bool, lo_w: int = LO_W):
     f, sh, _ = acc.shape
     nch = 5 if exact else 3
-    h = acc.reshape(f, sh, LO_W, nch).reshape(f, sh * LO_W, nch)[:, :num_bins]
+    h = acc.reshape(f, sh, lo_w, nch).reshape(f, sh * lo_w, nch)[:, :num_bins]
     if exact:
         return jnp.stack([h[..., 0] + h[..., 1],
                           h[..., 2] + h[..., 3], h[..., 4]], axis=-1)
     return h
 
 
-def _hist16_chunk_int8(cb, gq, hq, cnt, valid, num_bins: int):
+def _hist16_chunk_int8(cb, gq, hq, cnt, valid, num_bins: int,
+                       lo_w: int = LO_W):
     """int8 quantized chunk: one-hot x int8 dots accumulate in int32 on the
     MXU at 2x bf16 peak with ~2.5x less operand materialization."""
-    sh = (num_bins + LO_W - 1) // LO_W
-    hi = (cb >> 4).astype(jnp.uint8)
-    lo = (cb & 15).astype(jnp.uint8)
+    sh = (num_bins + lo_w - 1) // lo_w
+    hi = (cb >> _LO_SHIFT[lo_w]).astype(jnp.uint8)
+    lo = (cb & (lo_w - 1)).astype(jnp.uint8)
     hi_oh = (hi[:, :, None] == jnp.arange(sh, dtype=jnp.uint8)) \
         .astype(jnp.int8)                                    # (C, F, SH)
-    lo_oh = (lo[:, :, None] == jnp.arange(LO_W, dtype=jnp.uint8))
+    lo_oh = (lo[:, :, None] == jnp.arange(lo_w, dtype=jnp.uint8))
     v = valid.astype(jnp.int8)
     ch = jnp.stack([gq.astype(jnp.int8) * v, hq.astype(jnp.int8) * v,
                     cnt.astype(jnp.int8) * v], axis=1)       # (C, 3)
     c, f = cb.shape
     log_ = (lo_oh[:, :, :, None].astype(jnp.int8)
-            * ch[:, None, None, :]).reshape(c, f, LO_W * 3)
+            * ch[:, None, None, :]).reshape(c, f, lo_w * 3)
     return jnp.einsum("cfh,cfx->fhx", hi_oh, log_,
                       preferred_element_type=jnp.int32)
 
 
 def hist16_segment_q(work: jax.Array, plane, start, cnt, gscale, hscale, *,
                      num_bins: int, num_feat: int,
-                     chunk: int = 2048) -> jax.Array:
+                     chunk: int = 2048, lo_w: int = 0) -> jax.Array:
     """int8-quantized segment histogram -> dequantized (F, num_bins, 3) f32.
 
     work rows are (F + 3) u8: bins then int8 g, int8 h, u8 cnt
@@ -213,7 +226,8 @@ def hist16_segment_q(work: jax.Array, plane, start, cnt, gscale, hscale, *,
     from .partition import unpack_ghq
 
     f = num_feat
-    sh = (num_bins + LO_W - 1) // LO_W
+    lo_w = lo_w or auto_lo_w(f)
+    sh = (num_bins + lo_w - 1) // lo_w
     nchunks = (cnt + chunk - 1) // chunk
     width = work.shape[2]
 
@@ -225,12 +239,12 @@ def hist16_segment_q(work: jax.Array, plane, start, cnt, gscale, hscale, *,
         gq, hq, cq = unpack_ghq(cw, f)
         rows_left = cnt - i * chunk
         valid = jnp.arange(chunk, dtype=jnp.int32) < rows_left
-        return acc + _hist16_chunk_int8(cb, gq, hq, cq, valid, num_bins)
+        return acc + _hist16_chunk_int8(cb, gq, hq, cq, valid, num_bins, lo_w)
 
     acc = jax.lax.fori_loop(
         0, nchunks, body,
-        jnp.zeros((f, sh, LO_W * 3), jnp.int32))
-    h = acc.reshape(f, sh, LO_W, 3).reshape(f, sh * LO_W, 3)[:, :num_bins]
+        jnp.zeros((f, sh, lo_w * 3), jnp.int32))
+    h = acc.reshape(f, sh, lo_w, 3).reshape(f, sh * lo_w, 3)[:, :num_bins]
     scale = jnp.stack([1.0 / gscale, 1.0 / hscale,
                        jnp.float32(1.0)])
     return h.astype(jnp.float32) * scale[None, None, :]
@@ -238,7 +252,7 @@ def hist16_segment_q(work: jax.Array, plane, start, cnt, gscale, hscale, *,
 
 def hist16_segment(work: jax.Array, plane, start, cnt, *,
                    num_bins: int, num_feat: int, exact: bool = True,
-                   chunk: int = 2048) -> jax.Array:
+                   chunk: int = 2048, lo_w: int = 0) -> jax.Array:
     """Histogram of physical rows [start, start+cnt) of ping-pong plane
     ``plane`` -> (F, num_bins, 3).
 
@@ -250,7 +264,8 @@ def hist16_segment(work: jax.Array, plane, start, cnt, *,
     from .partition import unpack_ghc
 
     f = num_feat
-    sh = (num_bins + LO_W - 1) // LO_W
+    lo_w = lo_w or auto_lo_w(f)
+    sh = (num_bins + lo_w - 1) // lo_w
     nch = 5 if exact else 3
     nchunks = (cnt + chunk - 1) // chunk
     width = work.shape[2]
@@ -264,9 +279,9 @@ def hist16_segment(work: jax.Array, plane, start, cnt, *,
         rows_left = cnt - i * chunk
         valid = jnp.arange(chunk, dtype=jnp.int32) < rows_left
         cgm = cg * valid[:, None].astype(jnp.float32)
-        return acc + _hist16_chunk(cb, cgm, num_bins, exact)
+        return acc + _hist16_chunk(cb, cgm, num_bins, exact, lo_w)
 
     acc = jax.lax.fori_loop(
         0, nchunks, body,
-        jnp.zeros((f, sh, LO_W * nch), jnp.float32))
-    return _hist16_combine(acc, num_bins, exact)
+        jnp.zeros((f, sh, lo_w * nch), jnp.float32))
+    return _hist16_combine(acc, num_bins, exact, lo_w)

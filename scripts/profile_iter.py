@@ -69,17 +69,6 @@ def main():
     from lightgbm_tpu.fused import _obj_array_state
     ostate = _obj_array_state(obj)
 
-    def make_block(k):
-        fn = ft._block_fn(1)
-
-        def run():
-            out = fn(g.train_score.score, jnp.asarray(g._cegb_used),
-                     g._key, jnp.int32(0), lrn.bins, lrn.meta, ostate)
-            return out[0][0]
-        return run
-
-    # chain by block count: block of 1 iter; measure 1 vs K calls is host-
-    # bound. Instead use tpu_iter_block-like: build fns for k=1 and k=4.
     def make_blockk(k):
         g.config.tpu_iter_block = k
         ft2 = FusedTrainer(g)
