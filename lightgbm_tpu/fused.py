@@ -397,14 +397,21 @@ class FusedTrainer:
         self._pending = None
         try:
             stopped = self._finalize(pending)
-        finally:
+        except BaseException:
+            # best-effort sync while an exception is already propagating —
+            # only here is swallowing a secondary failure acceptable
             dev = self._cegb_used_dev
             if dev is not None:
                 try:
                     self.gbdt._cegb_used = np.asarray(dev)
                     self._cegb_used_dev = None
                 except Exception:
-                    pass  # device errors surface from _finalize instead
+                    pass
+            raise
+        dev = self._cegb_used_dev
+        if dev is not None:
+            self.gbdt._cegb_used = np.asarray(dev)
+            self._cegb_used_dev = None
         return stopped
 
     def _finalize(self, pending) -> bool:

@@ -1200,9 +1200,10 @@ class SerialTreeLearner:
                 # overhead vs O(ch^2) compaction matmul); the pallas kernel
                 # has no per-op overhead, so 1024 halves the matmul work
                 part_chunk = 1024 if part_kernel == "pallas" else 2048
-            if part_kernel == "pallas" and part_chunk % 32:
-                Log.fatal("tpu_part_chunk must be a multiple of 32 for the "
-                          "pallas partition kernel (got %d)", part_chunk)
+            if part_kernel == "pallas" and part_chunk % min(256, part_chunk):
+                Log.fatal("tpu_part_chunk must be a multiple of the 256-row "
+                          "compaction sub-block for the pallas partition "
+                          "kernel (got %d)", part_chunk)
             hist_chunk = int(config.tpu_hist_chunk)
             if hist_chunk <= 0:
                 # measured on v5e (lo_w-tuned einsum): 4096-row chunks win

@@ -195,11 +195,23 @@ def partition_segment(
 # per-op cost (~19 us/chunk profiled) dominates the actual work (~4 us).
 # A 255-leaf tree partitions ~5.6k chunks, so the op soup costs ~100 ms per
 # tree at 2M rows — the single largest line in the round-2 profile. The
-# Pallas version runs ONE kernel per split: an in-kernel chunk loop with
-# manual HBM<->VMEM DMA, the same route/rank/permute math, and blended
-# read-modify-write stores. Row ranks come from a strict-lower-triangular
-# bf16 matmul (exact: 0/1 operands, f32 accumulation) instead of cumsum,
-# and the compaction stays a permutation matmul on the MXU.
+# Pallas kernel runs ONE call per split: an in-kernel chunk loop with
+# manual HBM<->VMEM DMA and the route/rank/permute math on the MXU.
+#
+# v2 design (round 4; ~3x the v1 kernel, measured 1.7-2.4 vs 5-8 ns/row
+# interleaved at the bench shape):
+# - compaction permutation matmuls run per SB=256-row sub-block instead of
+#   per CH-row chunk — the perm matmul costs SB*W MACs/row, so sub-blocks
+#   cut the dominant MXU term ~4x;
+# - left/right frontier rows accumulate in circular VMEM stages (2*CH
+#   logical rows + CH of wrap margin) and flush to HBM as ALIGNED PURE
+#   WRITES of whole CH-row tiles — v1 paid a read-modify-write of ~CH+32
+#   rows on BOTH sides of every chunk plus a serializing lout.wait();
+# - aligned-edge neighbor bytes prefill once per call; the final sub-CH
+#   leftovers drain as full tiles plus one overlapping RMW tile.
+# Row order inside a leaf is insignificant (histograms are order-free;
+# sub-splits re-partition), so the kernel guarantees the row SET per side,
+# byte-preserving neighbors outside [start, start+cnt).
 
 
 ALIGN = 32  # Mosaic requires u8 DMA row offsets provably 32-aligned
@@ -223,131 +235,276 @@ def work_spec(num_groups: int, quantized: bool, part_kernel: str,
 
 
 def _partition_kernel(sref, work_in, table_ref, work_ref, lt_ref,
-                      tril, cin, cw2p, lbuf, rbuf, sem, *, ch, width, num_bin):
+                      tril, cin, pre, lstage, rstage, lfb, rfb, sem,
+                      *, ch, sb, width, num_bin):
     f32 = jnp.float32
-    cho = ch + ALIGN
+    lcap = 2 * ch
+    nsub = ch // sb
     src_plane = sref[0]
     start = sref[1]
     cnt = sref[2]
     feat = sref[3]
     dst_plane = 1 - src_plane
-    # reads cover [astart, astart + nchunks*ch) with 32-aligned offsets;
-    # the first `head` rows are masked invalid
-    astart = (start // ALIGN) * ALIGN
-    head = start - astart
+
+    def a32(x):
+        # Mosaic must PROVE u8 DMA row offsets divisible by the sublane
+        # tiling; loop-carried multiples of 32 are not provable, so every
+        # HBM offset is re-derived as (x // 32) * 32 at the use site.
+        return (x // ALIGN) * ALIGN
+
+    lbase0 = (start // ALIGN) * ALIGN
+    head_l = start - lbase0                      # 0..31 neighbor rows below
+    end = start + cnt
+    rtop = ((end - 1) // ALIGN) * ALIGN          # rbase0 - ALIGN, provable
+    rbase0 = rtop + ALIGN
+    tail_r = rbase0 - end                        # 0..31 neighbor rows above
+
+    astart = lbase0
+    head = head_l
     tot = head + cnt
     nchunks = (tot + ch - 1) // ch
 
     # strict lower-triangular ones: ranks[i] = sum_{j<i} flags[j].
     # Arithmetic construction (clamped integer difference) — boolean
-    # (CH, CH) selects hit Mosaic relayout limits on i1 vectors.
-    row_i = jax.lax.broadcasted_iota(jnp.int32, (ch, ch), 0)
-    col_i = jax.lax.broadcasted_iota(jnp.int32, (ch, ch), 1)
+    # selects hit Mosaic relayout limits on i1 vectors.
+    row_i = jax.lax.broadcasted_iota(jnp.int32, (sb, sb), 0)
+    col_i = jax.lax.broadcasted_iota(jnp.int32, (sb, sb), 1)
     tril[:] = jnp.clip(row_i - col_i, 0, 1).astype(f32).astype(jnp.bfloat16)
 
+    iota_sb = jax.lax.broadcasted_iota(jnp.int32, (sb, 1), 0)
     lane_w = jax.lax.broadcasted_iota(jnp.int32, (ch, width), 1)
     sub_i = jax.lax.broadcasted_iota(jnp.int32, (ch, 1), 0)
-    sub_o = jax.lax.broadcasted_iota(jnp.int32, (cho, 1), 0)
+
+    # ---- prefills: neighbor rows of the aligned edge tiles ----
+    pl_in = pltpu.make_async_copy(
+        work_in.at[dst_plane, pl.ds(lbase0, ALIGN), :], pre.at[0], sem.at[2])
+    pl_in.start()
+    pr_in = pltpu.make_async_copy(
+        work_in.at[dst_plane, pl.ds(rtop, ALIGN), :], pre.at[1], sem.at[3])
+    pr_in.start()
 
     def start_in(i, slot):
-        off = astart + i * ch
         pltpu.make_async_copy(
-            work_in.at[src_plane, pl.ds(off, ch), :], cin.at[slot],
-            sem.at[slot]).start()
+            work_in.at[src_plane, pl.ds(a32(astart + i * ch), ch), :],
+            cin.at[slot], sem.at[slot]).start()
 
-    # double-buffered input: chunk i+1 streams in while i computes
     start_in(0, 0)
 
+    pl_in.wait()
+    lstage[0:ALIGN, :] = pre[0].astype(jnp.int32).astype(f32)
+    pr_in.wait()
+    rstage[ch - ALIGN:ch, :] = pre[1].astype(jnp.int32).astype(f32)
+
+    def flush(stage, fb, flushed, left, sem_base):
+        """Convert the ready CH-row stage half, start its pure HBM write."""
+        half = jax.lax.rem(flushed // ch, 2)
+        slot = half
+        nflush = flushed // ch
+
+        # slot reuse: wait the DMA issued 2 flushes ago (size-matched
+        # reconstruction; .wait() only consumes the semaphore)
+        @pl.when(nflush >= 2)
+        def _():
+            pltpu.make_async_copy(
+                fb.at[slot], work_ref.at[dst_plane, pl.ds(0, ch), :],
+                sem.at[sem_base + slot]).wait()
+        fb[slot] = stage[pl.ds(half * ch, ch)].astype(jnp.int32) \
+            .astype(jnp.uint8)
+        if left:
+            at = a32(lbase0 + flushed)
+        else:
+            at = a32(rbase0 - flushed - ch)
+        pltpu.make_async_copy(
+            fb.at[slot], work_ref.at[dst_plane, pl.ds(at, ch), :],
+            sem.at[sem_base + slot]).start()
+
+    def append(stage, out, n, ws, fill_sel_left):
+        """Blend `n` compacted rows into the circular stage at window ws."""
+        win = stage[pl.ds(ws, sb)]
+        if fill_sel_left:
+            m = iota_sb < n
+        else:
+            m = iota_sb >= sb - n
+        stage[pl.ds(ws, sb)] = jnp.where(m, out, win)
+
+        @pl.when(ws + sb > lcap)
+        def _():
+            # wrap: rows written into the margin [lcap, ws+sb) are logical
+            # [0, ov). Blend ONLY those — on the descending (right) side
+            # the rows at [ov, sb) hold current, not-yet-flushed data.
+            ov = ws + sb - lcap
+            stage[0:sb, :] = jnp.where(iota_sb < ov,
+                                       stage[lcap:lcap + sb, :],
+                                       stage[0:sb, :])
+
     def body(i, carry):
-        lcur, rcur = carry
+        p_l, p_r, fl_l, fl_r = carry
         slot = jax.lax.rem(i, 2)
         pltpu.make_async_copy(
-            work_in.at[src_plane, pl.ds(astart + i * ch, ch), :],
+            work_in.at[src_plane, pl.ds(a32(astart + i * ch), ch), :],
             cin.at[slot], sem.at[slot]).wait()
 
         @pl.when(i + 1 < nchunks)
         def _():
             start_in(i + 1, 1 - slot)
 
-        # the left read-modify window depends only on lcur: overlap its
-        # read with the routing/compaction compute
-        wl = (lcur // ALIGN) * ALIGN
-        dl = lcur - wl
-        lin = pltpu.make_async_copy(
-            work_in.at[dst_plane, pl.ds(wl, cho), :], lbuf, sem.at[2])
-        lin.start()
-
         # Mosaic has no direct u8<->f32 casts; bounce through i32
-        cf = cin[slot].astype(jnp.int32).astype(f32)         # (CH, W)
+        cf = cin[slot].astype(jnp.int32).astype(f32)          # (CH, W)
         col = jnp.sum(jnp.where(lane_w == feat, cf, 0.0), axis=1,
-                      keepdims=True)                         # (CH, 1) f32
+                      keepdims=True)                          # (CH, 1)
         # routing table lookup as a one-hot contraction over the bin axis
         bin_l = jax.lax.broadcasted_iota(jnp.int32, (ch, num_bin), 1)
         oh = (1 - jnp.clip(jnp.abs(bin_l - col.astype(jnp.int32)), 0, 1)) \
             .astype(f32)
         go = jnp.sum(oh * table_ref[:], axis=1, keepdims=True) > 0.5
         pos = sub_i + i * ch
-        valid = (pos >= head) & (pos < tot)                  # (CH, 1)
-        gl = go & valid
-        gr = (~go) & valid
-        flags = jnp.concatenate(
-            [gl.astype(jnp.bfloat16), gr.astype(jnp.bfloat16),
-             (~valid).astype(jnp.bfloat16)], axis=1)         # (CH, 3)
-        ranks = jax.lax.dot(tril[:], flags,
-                            preferred_element_type=f32)      # (CH, 3)
-        nl = jnp.sum(gl.astype(jnp.int32))
-        nr = jnp.sum(gr.astype(jnp.int32))
-        lrank = ranks[:, 0:1].astype(jnp.int32)
-        rrank = ranks[:, 1:2].astype(jnp.int32)
-        irank = ranks[:, 2:3].astype(jnp.int32)
-        dest = jnp.where(gl, lrank,
-                         jnp.where(gr, ch - nr + rrank, nl + irank))  # (CH,1)
-        # permutation one-hot: perm[j, i] = (dest_i == j); compacted = P @ cw
-        destT = dest.reshape(1, ch)
-        perm = (1 - jnp.clip(
-            jnp.abs(jax.lax.broadcasted_iota(jnp.int32, (ch, ch), 0) - destT),
-            0, 1)).astype(f32).astype(jnp.bfloat16)
-        # keep the compacted chunk in f32 (exact byte integers): Mosaic's
-        # dynamic rotate has no i8 form
-        cw2p[0:ch, :] = jax.lax.dot(perm, cf.astype(jnp.bfloat16),
-                                    preferred_element_type=f32)
+        valid = (pos >= head) & (pos < tot)                   # (CH, 1)
 
-        # Writes go to 32-aligned windows of CHO = CH + 32 rows; cursor
-        # misalignment is absorbed by a cyclic roll of the compacted chunk,
-        # and blends keep only the landed rows.
-        rolled_l = pltpu.roll(cw2p[:], dl, 0)
-        lin.wait()
-        lb = lbuf[:].astype(jnp.int32).astype(f32)
-        lb = jnp.where((sub_o >= dl) & (sub_o < dl + nl), rolled_l, lb)
-        lbuf[:] = lb.astype(jnp.int32).astype(jnp.uint8)
-        lout = pltpu.make_async_copy(
-            lbuf, work_ref.at[dst_plane, pl.ds(wl, cho), :], sem.at[2])
-        lout.start()
+        for s in range(nsub):
+            sub = cf[s * sb:(s + 1) * sb]                     # (SB, W)
+            gl = go[s * sb:(s + 1) * sb] & valid[s * sb:(s + 1) * sb]
+            gr = (~go[s * sb:(s + 1) * sb]) & valid[s * sb:(s + 1) * sb]
+            flags = jnp.concatenate(
+                [gl.astype(jnp.bfloat16), gr.astype(jnp.bfloat16)], axis=1)
+            ranks = jax.lax.dot(tril[:], flags,
+                                preferred_element_type=f32)   # (SB, 2)
+            nl = jnp.sum(gl.astype(jnp.int32))
+            nr = jnp.sum(gr.astype(jnp.int32))
+            lrank = ranks[:, 0:1].astype(jnp.int32)
+            rrank = ranks[:, 1:2].astype(jnp.int32)
+            # left rows rank to the window front; right rows to window
+            # offsets sb-1-rrank (descending cursor); unrouted rows get -1
+            dest_l = jnp.where(gl, lrank, -1)
+            dest_r = jnp.where(gr, sb - 1 - rrank, -1)
+            j_i = jax.lax.broadcasted_iota(jnp.int32, (sb, sb), 0)
+            perm_l = (1 - jnp.clip(jnp.abs(j_i - dest_l.reshape(1, sb)),
+                                   0, 1)).astype(f32).astype(jnp.bfloat16)
+            perm_r = (1 - jnp.clip(jnp.abs(j_i - dest_r.reshape(1, sb)),
+                                   0, 1)).astype(f32).astype(jnp.bfloat16)
+            # u8 payload bytes are integers <= 255: exact under a 0/1 bf16
+            # permutation matmul with f32 accumulation
+            sub_bf = sub.astype(jnp.bfloat16)
+            out_l = jax.lax.dot(perm_l, sub_bf, preferred_element_type=f32)
+            out_r = jax.lax.dot(perm_r, sub_bf, preferred_element_type=f32)
 
-        # right rows sit at [CH-nr, CH) in cw2p; land them at
-        # [rcur-nr, rcur). The left write must complete first: the two
-        # windows overlap when the cursors meet mid-segment.
-        rstart = rcur - nr
-        wr = (rstart // ALIGN) * ALIGN
-        dr = rstart - wr
-        shift_r = jnp.remainder(dr - (ch - nr), cho)
-        rolled_r = pltpu.roll(cw2p[:], shift_r, 0)
-        lout.wait()
-        rin = pltpu.make_async_copy(
-            work_in.at[dst_plane, pl.ds(wr, cho), :], rbuf, sem.at[3])
-        rin.start()
-        rin.wait()
-        rb = rbuf[:].astype(jnp.int32).astype(f32)
-        rb = jnp.where((sub_o >= dr) & (sub_o < dr + nr), rolled_r, rb)
-        rbuf[:] = rb.astype(jnp.int32).astype(jnp.uint8)
-        rout = pltpu.make_async_copy(
-            rbuf, work_ref.at[dst_plane, pl.ds(wr, cho), :], sem.at[3])
-        rout.start()
-        rout.wait()
-        return lcur + nl, rcur - nr
+            ws_l = jax.lax.rem(p_l, lcap)
+            append(lstage, out_l, nl, ws_l, True)
+            p_l = p_l + nl
 
-    lcur, _ = jax.lax.fori_loop(0, nchunks, body, (start, start + cnt))
-    lt_ref[0] = lcur - start
+            @pl.when(p_l - fl_l >= ch)
+            def _():
+                flush(lstage, lfb, fl_l, True, 4)
+            fl_l = jnp.where(p_l - fl_l >= ch, fl_l + ch, fl_l)
+
+            # window start (CH - p_r - SB) mod LCAP, kept positive before
+            # rem (lax.rem keeps the dividend's sign)
+            ws_r = jax.lax.rem(ch - jax.lax.rem(p_r, lcap) - sb + 2 * lcap,
+                               lcap)
+            append(rstage, out_r, nr, ws_r, False)
+            p_r = p_r + nr
+
+            @pl.when(p_r - fl_r >= ch)
+            def _():
+                flush(rstage, rfb, fl_r, False, 6)
+            fl_r = jnp.where(p_r - fl_r >= ch, fl_r + ch, fl_r)
+
+        return p_l, p_r, fl_l, fl_r
+
+    p_l, p_r, fl_l, fl_r = jax.lax.fori_loop(
+        0, nchunks, body, (head_l, tail_r, jnp.int32(0), jnp.int32(0)))
+
+    # ---- drain leftovers: [lbase0+fl_l, rbase0-fl_r), all 32-aligned ----
+    fill_l = p_l - fl_l
+    fill_r = p_r - fl_r
+    d = fill_l + fill_r
+    dstart = lbase0 + fl_l
+
+    # wait outstanding flush DMAs (the drain RMW tile may read their rows,
+    # and kernel exit requires drained semaphores). The reconstruction uses
+    # lfb for both sides — only the semaphore index and byte count matter.
+    for base, fl in ((4, fl_l), (6, fl_r)):
+        nf = fl // ch
+        for back in (1, 2):
+            @pl.when(nf >= back)
+            def _(base=base, nf=nf, back=back):
+                pltpu.make_async_copy(
+                    lfb.at[jax.lax.rem(nf - back, 2)],
+                    work_ref.at[dst_plane, pl.ds(0, ch), :],
+                    sem.at[base + jax.lax.rem(nf - back, 2)]).wait()
+
+    def read_circ(stage, qstart):
+        """(ch, W) rows of the circular stage starting at logical qstart.
+        Robust to any-sign qstart (true mathematical mod)."""
+        qs = jax.lax.rem(jax.lax.rem(qstart, lcap) + lcap, lcap)
+        a = stage[pl.ds(qs, ch)]
+        b = stage[pl.ds(0, ch)]
+        lim = lcap - qs
+        rolled = pltpu.roll(b, lim, 0)
+        return jnp.where(sub_i[:ch] < lim, a, rolled)
+
+    qr0 = jax.lax.rem(ch - jax.lax.rem(p_r, lcap) + 2 * lcap, lcap)
+
+    def drain_tile(o):
+        """(ch, W) drain rows for drain offsets [o, o+ch)."""
+        lrows = read_circ(lstage, fl_l + o)
+        rrows = read_circ(rstage, qr0 + (o - fill_l))
+        off = sub_i[:ch] + o
+        return jnp.where(off < fill_l, lrows, rrows)
+
+    nfull = d // ch
+    MAXT = 4  # d < 2*(ch+sb) <= 3*ch when sb <= ch/2
+
+    def dbody(t, _):
+        @pl.when(t < nfull)
+        def _():
+            slot = jax.lax.rem(t, 2)
+
+            @pl.when(t >= 2)
+            def _():
+                pltpu.make_async_copy(
+                    lfb.at[slot], work_ref.at[dst_plane, pl.ds(0, ch), :],
+                    sem.at[4 + slot]).wait()
+            lfb[slot] = drain_tile(t * ch).astype(jnp.int32).astype(jnp.uint8)
+            pltpu.make_async_copy(
+                lfb.at[slot], work_ref.at[dst_plane,
+                                          pl.ds(a32(dstart + t * ch), ch), :],
+                sem.at[4 + slot]).start()
+        return 0
+
+    jax.lax.fori_loop(0, MAXT, dbody, 0)
+    for back in range(1, 3):
+        @pl.when(nfull >= back)
+        def _(back=back):
+            pltpu.make_async_copy(
+                lfb.at[jax.lax.rem(nfull - back, 2)],
+                work_ref.at[dst_plane, pl.ds(0, ch), :],
+                sem.at[4 + jax.lax.rem(nfull - back, 2)]).wait()
+
+    rem_ = d - nfull * ch
+
+    @pl.when(rem_ > 0)
+    def _():
+        # one overlapping RMW tile ending exactly at the region end: rows
+        # with drain offset in [nfull*ch, d) are fresh; below that the RMW
+        # re-reads what full tiles just wrote (identical) or, when d < ch,
+        # pre-segment bytes that must be preserved
+        at = a32(dstart + d - ch)
+        rd = pltpu.make_async_copy(
+            work_in.at[dst_plane, pl.ds(at, ch), :], lfb.at[0], sem.at[4])
+        rd.start()
+        rd.wait()
+        tile = drain_tile(d - ch)
+        old = lfb[0].astype(jnp.int32).astype(f32)
+        off = sub_i[:ch] + (d - ch)
+        keep_new = (off >= jnp.int32(nfull) * ch) & (off >= 0)
+        merged = jnp.where(keep_new, tile, old)
+        lfb[0] = merged.astype(jnp.int32).astype(jnp.uint8)
+        wr = pltpu.make_async_copy(
+            lfb.at[0], work_ref.at[dst_plane, pl.ds(at, ch), :], sem.at[4])
+        wr.start()
+        wr.wait()
+
+    lt_ref[0] = p_l - head_l
 
 
 def partition_segment_fused(
@@ -359,12 +516,15 @@ def partition_segment_fused(
     go_left: jax.Array,    # (B,) bool
     *,
     ch: int = DEFAULT_CH,
+    sb: int = 256,
 ) -> Tuple[jax.Array, jax.Array]:
-    """Pallas form of :func:`partition_segment` (same contract).
+    """Pallas form of :func:`partition_segment` (same contract, except row
+    order WITHIN each side is unspecified — insignificant for this
+    framework: histograms are order-free and sub-splits re-partition).
 
     Requires the work buffer's row width padded to 128 (DMA slices must
     cover whole 128-lane tiles) and guard regions of at least ch + 32 rows
-    (write windows extend up to 32 rows past the segment on both sides).
+    (edge tiles and input reads extend past the segment on both sides).
     """
     num_bin = go_left.shape[0]
     width = work.shape[2]
@@ -372,11 +532,16 @@ def partition_segment_fused(
         raise ValueError(
             "fused partition needs width as whole 128-lane tiles, got %d"
             % width)
+    sb = min(sb, ch)
+    if ch % sb:
+        raise ValueError("partition chunk %d must be a multiple of the "
+                         "sub-block %d" % (ch, sb))
     scalars = jnp.stack([src_plane.astype(jnp.int32), start.astype(jnp.int32),
                          cnt.astype(jnp.int32), feat.astype(jnp.int32)])
     table = go_left.astype(jnp.float32).reshape(1, num_bin)
 
-    kern = partial(_partition_kernel, ch=ch, width=width, num_bin=num_bin)
+    kern = partial(_partition_kernel, ch=ch, sb=sb, width=width,
+                   num_bin=num_bin)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(1,),
@@ -389,12 +554,14 @@ def partition_segment_fused(
             pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         scratch_shapes=[
-            pltpu.VMEM((ch, ch), jnp.bfloat16),        # tril
-            pltpu.VMEM((2, ch, width), jnp.uint8),     # cin x2
-            pltpu.VMEM((ch + ALIGN, width), jnp.float32),  # cw2p
-            pltpu.VMEM((ch + ALIGN, width), jnp.uint8),  # lbuf
-            pltpu.VMEM((ch + ALIGN, width), jnp.uint8),  # rbuf
-            pltpu.SemaphoreType.DMA((4,)),
+            pltpu.VMEM((sb, sb), jnp.bfloat16),            # tril
+            pltpu.VMEM((2, ch, width), jnp.uint8),         # cin x2
+            pltpu.VMEM((2, ALIGN, width), jnp.uint8),      # edge prefills
+            pltpu.VMEM((3 * ch, width), jnp.float32),      # lstage
+            pltpu.VMEM((3 * ch, width), jnp.float32),      # rstage
+            pltpu.VMEM((2, ch, width), jnp.uint8),         # lfb x2
+            pltpu.VMEM((2, ch, width), jnp.uint8),         # rfb x2
+            pltpu.SemaphoreType.DMA((8,)),
         ],
     )
     work_out, lt = pl.pallas_call(
@@ -405,6 +572,6 @@ def partition_segment_fused(
         input_output_aliases={1: 0},
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",),
-            vmem_limit_bytes=64 * 1024 * 1024),
+            vmem_limit_bytes=100 * 1024 * 1024),
     )(scalars, work, table)
     return work_out, lt[0]
