@@ -67,19 +67,35 @@ def _config_fp(cfg: Config) -> str:
     return _fp_hash(items)
 
 
+def _is_array_tree(v) -> bool:
+    """True for a non-empty pytree (list/tuple/dict nesting) whose leaves
+    are ALL jax.Arrays — e.g. the ranking objectives' per-bucket tables."""
+    if isinstance(v, jax.Array):
+        return True
+    if isinstance(v, (list, tuple)):
+        return bool(v) and all(_is_array_tree(x) for x in v)
+    if isinstance(v, dict):
+        return bool(v) and all(_is_array_tree(x) for x in v.values())
+    return False
+
+
 def _obj_array_state(obj) -> dict:
-    """The objective's jax.Array attributes, to be passed as jit operands."""
-    return {k: v for k, v in vars(obj).items() if isinstance(v, jax.Array)}
+    """The objective's jax.Array(-pytree) attributes, passed as jit
+    operands so no N-sized data embeds in the trace."""
+    return {k: v for k, v in vars(obj).items() if _is_array_tree(v)}
 
 
 def _obj_static_fp(obj) -> str:
     """Fingerprint of everything on the objective that is NOT passed as an
-    operand (python scalars, np arrays — these embed in the trace)."""
+    operand (python scalars, np arrays — these embed in the trace). Array
+    pytrees contribute their structure + leaf signatures only."""
     items = []
     for k in sorted(vars(obj)):
         v = getattr(obj, k)
-        if isinstance(v, jax.Array):
-            items.append((k, "arr", str(v.shape), str(v.dtype)))
+        if _is_array_tree(v):
+            sig = [(str(a.shape), str(a.dtype)) for a in jax.tree.leaves(v)]
+            items.append((k, "arrtree", repr(jax.tree.structure(v)),
+                          repr(sig)))
         else:
             items.append((k, _fp_hash(v)))
     return _fp_hash([type(obj).__name__, items])
@@ -254,7 +270,8 @@ class FusedTrainer:
         build = learner.make_build_fn()
         wspec = learner.work_buf_spec()
 
-        def one_iter(sampler, bins, meta, score, cegb_used, wbuf, key, it):
+        def one_iter(sampler, bins, bins_t, meta, score, cegb_used, wbuf,
+                     key, it):
             if obj.needs_iter:
                 g, h = obj.get_gradients(score, it)
             else:
@@ -281,7 +298,7 @@ class FusedTrainer:
                     log, wbuf = build(
                         bins, ghc, meta, fmask,
                         jax.random.fold_in(key, it * 131 + c), cegb_used,
-                        work_buf=wbuf, return_work=True)
+                        work_buf=wbuf, return_work=True, bins_t=bins_t)
                 else:
                     log = build(bins, ghc, meta, fmask,
                                 jax.random.fold_in(key, it * 131 + c),
@@ -319,11 +336,31 @@ class FusedTrainer:
                 # costs ~260 MB of HBM writes at 2M rows)
                 wbuf = jnp.zeros(wspec[0], wspec[1]) \
                     if wspec is not None else jnp.zeros((), jnp.uint8)
+                # transposed bins for the per-tree routing pass, computed
+                # once per block (loop-invariant; ~20 ms at 2M x 28). When
+                # the Pallas route kernel applies, hoist its padded
+                # (F, npad/128, 128) block form so no per-tree pad/reshape
+                # copy rides inside the scan body.
+                bins_t = None
+                if wspec is not None:
+                    from .ops.route import ROUTE_BLOCK_ROWS, pltpu
+                    bins_t = bins.T
+                    if (pltpu is not None and not learner.hp.has_categorical
+                            and jax.default_backend() in ("tpu", "axon")):
+                        n_ = bins.shape[0]
+                        npad = ((n_ + ROUTE_BLOCK_ROWS - 1)
+                                // ROUTE_BLOCK_ROWS) * ROUTE_BLOCK_ROWS
+                        if npad != n_:
+                            bins_t = jnp.pad(bins_t,
+                                             ((0, 0), (0, npad - n_)))
+                        bins_t = bins_t.reshape(bins.shape[1],
+                                                npad // 128, 128)
 
                 def body(carry, i):
                     score, used, wbuf = carry
                     score, used, wbuf, stacked = one_iter(
-                        sampler, bins, meta, score, used, wbuf, key, it0 + i)
+                        sampler, bins, bins_t, meta, score, used, wbuf, key,
+                        it0 + i)
                     return (score, used, wbuf), stacked
                 (score, used, _), stacked = jax.lax.scan(
                     body, (score, cegb_used, wbuf), jnp.arange(k))

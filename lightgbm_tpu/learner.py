@@ -499,6 +499,9 @@ def build_tree_partitioned(
     part_kernel: str = "xla",  # xla | pallas (fused DMA kernel, TPU only)
     work_buf: Optional[jax.Array] = None,  # carried (2, Npad, W) u8 buffer
     return_work: bool = False,
+    bins_t: Optional[jax.Array] = None,    # (F, N) transposed bins — pass a
+    # block-hoisted copy when building many trees (the transpose costs
+    # ~20 ms at 2M x 28; assign_leaves needs the transposed layout)
 ) -> TreeLog:
     """Grow one leaf-wise tree with a physical row partition.
 
@@ -922,7 +925,7 @@ def build_tree_partitioned(
     (_, work_fin, _, _, _, _, leaf_sum, _, leaf_out, _, _, _, _, log, _, _,
      _) = carry
     row_leaf = assign_leaves(bins, log, has_categorical=hp.has_categorical,
-                             bundle=bundle)
+                             bundle=bundle, bins_t=bins_t)
     log = log._replace(leaf_value=leaf_out, leaf_sum=leaf_sum,
                        row_leaf=row_leaf)
     if return_work:
@@ -933,7 +936,8 @@ def build_tree_partitioned(
 @partial(jax.jit, static_argnames=("has_categorical",))
 def assign_leaves(bins: jax.Array, log: TreeLog,
                   has_categorical: bool = True,
-                  bundle: Optional[dict] = None) -> jax.Array:
+                  bundle: Optional[dict] = None,
+                  bins_t: Optional[jax.Array] = None) -> jax.Array:
     """Route binned rows through a tree's split log (device analog of
     Tree::PredictLeafIndex over pre-binned data; used for valid-set score
     updates, mirroring ScoreUpdater's use of the data partition,
@@ -950,12 +954,38 @@ def assign_leaves(bins: jax.Array, log: TreeLog,
     """
     n = bins.shape[0]
     max_splits = log.split_leaf.shape[0]
-    row_leaf = jnp.zeros((n,), jnp.int32)
+    # fast path: numerical(-or-bundled) trees route in ONE streaming Pallas
+    # pass (ops/route.py) — the fori form below re-reads the matrix and the
+    # leaf vector once per round (~30 ms/tree at 2M x 28 vs ~5 ms)
+    if not has_categorical:
+        from .ops.route import (ROUTE_BLOCK_ROWS, build_route_table,
+                                route_rows, pltpu)
+        if pltpu is not None and jax.default_backend() in ("tpu", "axon"):
+            if bins_t is not None and bins_t.ndim == 3:
+                btr = bins_t   # pre-padded (F, npad/128, 128) block form
+            else:
+                bt = bins_t if bins_t is not None else bins.T
+                rb = ROUTE_BLOCK_ROWS
+                npad = ((n + rb - 1) // rb) * rb
+                if npad != n:
+                    bt = jnp.pad(bt, ((0, 0), (0, npad - n)))
+                btr = bt.reshape(bins.shape[1], npad // 128, 128)
+            table = build_route_table(log, None, bundle)
+            return route_rows(btr, table, log.num_splits, n)[:n]
+    # the routing state is pure HBM traffic (a full-N read-modify-write per
+    # round): u8 leaf ids cut it 4x whenever they fit (num_leaves <= 256 —
+    # always true for the partitioned builder's default shapes)
+    small = max_splits + 1 <= 256
+    ldt = jnp.uint8 if small else jnp.int32
+    row_leaf = jnp.zeros((n,), ldt)
     # one transpose up front: each routing round then reads ONE contiguous
     # (N,) row instead of gathering a strided column from the row-major
     # matrix (the column gather re-streams the whole matrix per round —
-    # measured ~30 ms/tree at 2M x 28; transposed rounds are ~6 ms total)
-    bins_t = bins.T
+    # measured ~30 ms/tree at 2M x 28; transposed rounds are ~6 ms total).
+    # Callers building many trees pass a hoisted bins_t (the u8 transpose
+    # itself costs ~20 ms at 2M x 28).
+    if bins_t is None:
+        bins_t = bins.T
 
     def body(r, row_leaf):
         active = r < log.num_splits
@@ -999,10 +1029,12 @@ def assign_leaves(bins: jax.Array, log: TreeLog,
                               col)
         else:
             go = go_numerical(col)
-        upd = jnp.where((row_leaf == leaf) & ~go, r + 1, row_leaf)
+        upd = jnp.where((row_leaf == leaf.astype(ldt)) & ~go,
+                        (r + 1).astype(ldt), row_leaf)
         return jnp.where(active, upd, row_leaf)
 
-    return jax.lax.fori_loop(0, max_splits, body, row_leaf)
+    out = jax.lax.fori_loop(0, max_splits, body, row_leaf)
+    return out.astype(jnp.int32)
 
 
 def leaf_values_by_row(leaf_value: jax.Array, row_leaf: jax.Array,
@@ -1200,10 +1232,12 @@ class SerialTreeLearner:
                 # overhead vs O(ch^2) compaction matmul); the pallas kernel
                 # has no per-op overhead, so 1024 halves the matmul work
                 part_chunk = 1024 if part_kernel == "pallas" else 2048
-            if part_kernel == "pallas" and part_chunk % min(256, part_chunk):
-                Log.fatal("tpu_part_chunk must be a multiple of the 256-row "
-                          "compaction sub-block for the pallas partition "
-                          "kernel (got %d)", part_chunk)
+            if part_kernel == "pallas" and (
+                    part_chunk % 32
+                    or (part_chunk > 256 and part_chunk % 256)):
+                Log.fatal("tpu_part_chunk must be a multiple of 32 and, "
+                          "above 256, a multiple of the 256-row compaction "
+                          "sub-block (got %d)", part_chunk)
             hist_chunk = int(config.tpu_hist_chunk)
             if hist_chunk <= 0:
                 # measured on v5e (lo_w-tuned einsum): 4096-row chunks win

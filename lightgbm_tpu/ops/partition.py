@@ -308,7 +308,8 @@ def _partition_kernel(sref, work_in, table_ref, work_ref, lt_ref,
             pltpu.make_async_copy(
                 fb.at[slot], work_ref.at[dst_plane, pl.ds(0, ch), :],
                 sem.at[sem_base + slot]).wait()
-        fb[slot] = stage[pl.ds(half * ch, ch)].astype(jnp.int32) \
+        hs = (half * ch // 8) * 8  # == half*ch; the pattern proves alignment
+        fb[slot] = stage[pl.ds(hs, ch)].astype(jnp.int32) \
             .astype(jnp.uint8)
         if left:
             at = a32(lbase0 + flushed)
@@ -318,20 +319,30 @@ def _partition_kernel(sref, work_in, table_ref, work_ref, lt_ref,
             fb.at[slot], work_ref.at[dst_plane, pl.ds(at, ch), :],
             sem.at[sem_base + slot]).start()
 
-    def append(stage, out, n, ws, fill_sel_left):
-        """Blend `n` compacted rows into the circular stage at window ws."""
-        win = stage[pl.ds(ws, sb)]
+    iota_sb8 = jax.lax.broadcasted_iota(jnp.int32, (sb + 8, 1), 0)
+
+    def append(stage, out8, n, ws, dlt, fill_sel_left):
+        """Blend `n` compacted rows into the circular stage at window ws.
+
+        Mosaic requires dynamic sublane window offsets provably 8-aligned
+        for wide loads, so the window is [ws8, ws8 + sb + 8) with
+        ws8 = align8(ws); ``out8`` rows are pre-shifted by dlt = ws - ws8
+        (the permutation matmul absorbs the shift into its dest indices).
+        """
+        ws8 = (ws // 8) * 8
+        win = stage[pl.ds(ws8, sb + 8)]
         if fill_sel_left:
-            m = iota_sb < n
+            m = (iota_sb8 >= dlt) & (iota_sb8 < dlt + n)
         else:
-            m = iota_sb >= sb - n
-        stage[pl.ds(ws, sb)] = jnp.where(m, out, win)
+            m = (iota_sb8 >= dlt + sb - n) & (iota_sb8 < dlt + sb)
+        stage[pl.ds(ws8, sb + 8)] = jnp.where(m, out8, win)
 
         @pl.when(ws + sb > lcap)
         def _():
-            # wrap: rows written into the margin [lcap, ws+sb) are logical
+            # wrap: append dests in the margin [lcap, ws+sb) are logical
             # [0, ov). Blend ONLY those — on the descending (right) side
-            # the rows at [ov, sb) hold current, not-yet-flushed data.
+            # the rows at [ov, sb) hold current, not-yet-flushed data, and
+            # the 8-row alignment pad beyond ws+sb holds stale bytes.
             ov = ws + sb - lcap
             stage[0:sb, :] = jnp.where(iota_sb < ov,
                                        stage[lcap:lcap + sb, :],
@@ -372,11 +383,19 @@ def _partition_kernel(sref, work_in, table_ref, work_ref, lt_ref,
             nr = jnp.sum(gr.astype(jnp.int32))
             lrank = ranks[:, 0:1].astype(jnp.int32)
             rrank = ranks[:, 1:2].astype(jnp.int32)
+            ws_l = jax.lax.rem(p_l, lcap)
+            dlt_l = ws_l - (ws_l // 8) * 8
+            # window start (CH - p_r - SB) mod LCAP, kept positive before
+            # rem (lax.rem keeps the dividend's sign)
+            ws_r = jax.lax.rem(ch - jax.lax.rem(p_r, lcap) - sb + 2 * lcap,
+                               lcap)
+            dlt_r = ws_r - (ws_r // 8) * 8
             # left rows rank to the window front; right rows to window
-            # offsets sb-1-rrank (descending cursor); unrouted rows get -1
-            dest_l = jnp.where(gl, lrank, -1)
-            dest_r = jnp.where(gr, sb - 1 - rrank, -1)
-            j_i = jax.lax.broadcasted_iota(jnp.int32, (sb, sb), 0)
+            # offsets sb-1-rrank (descending cursor); unrouted rows get -1;
+            # dests shift by the window's 8-row alignment remainder
+            dest_l = jnp.where(gl, lrank + dlt_l, -1)
+            dest_r = jnp.where(gr, sb - 1 - rrank + dlt_r, -1)
+            j_i = jax.lax.broadcasted_iota(jnp.int32, (sb + 8, sb), 0)
             perm_l = (1 - jnp.clip(jnp.abs(j_i - dest_l.reshape(1, sb)),
                                    0, 1)).astype(f32).astype(jnp.bfloat16)
             perm_r = (1 - jnp.clip(jnp.abs(j_i - dest_r.reshape(1, sb)),
@@ -387,8 +406,7 @@ def _partition_kernel(sref, work_in, table_ref, work_ref, lt_ref,
             out_l = jax.lax.dot(perm_l, sub_bf, preferred_element_type=f32)
             out_r = jax.lax.dot(perm_r, sub_bf, preferred_element_type=f32)
 
-            ws_l = jax.lax.rem(p_l, lcap)
-            append(lstage, out_l, nl, ws_l, True)
+            append(lstage, out_l, nl, ws_l, dlt_l, True)
             p_l = p_l + nl
 
             @pl.when(p_l - fl_l >= ch)
@@ -396,11 +414,7 @@ def _partition_kernel(sref, work_in, table_ref, work_ref, lt_ref,
                 flush(lstage, lfb, fl_l, True, 4)
             fl_l = jnp.where(p_l - fl_l >= ch, fl_l + ch, fl_l)
 
-            # window start (CH - p_r - SB) mod LCAP, kept positive before
-            # rem (lax.rem keeps the dividend's sign)
-            ws_r = jax.lax.rem(ch - jax.lax.rem(p_r, lcap) - sb + 2 * lcap,
-                               lcap)
-            append(rstage, out_r, nr, ws_r, False)
+            append(rstage, out_r, nr, ws_r, dlt_r, False)
             p_r = p_r + nr
 
             @pl.when(p_r - fl_r >= ch)
@@ -434,9 +448,13 @@ def _partition_kernel(sref, work_in, table_ref, work_ref, lt_ref,
 
     def read_circ(stage, qstart):
         """(ch, W) rows of the circular stage starting at logical qstart.
-        Robust to any-sign qstart (true mathematical mod)."""
+        Robust to any-sign qstart (true mathematical mod). The load is
+        8-aligned (Mosaic wide-load constraint); the remainder is absorbed
+        by a roll."""
         qs = jax.lax.rem(jax.lax.rem(qstart, lcap) + lcap, lcap)
-        a = stage[pl.ds(qs, ch)]
+        qs8 = (qs // 8) * 8
+        dlt = qs - qs8
+        a = pltpu.roll(stage[pl.ds(qs8, ch + 8)], -dlt, 0)[:ch]
         b = stage[pl.ds(0, ch)]
         lim = lcap - qs
         rolled = pltpu.roll(b, lim, 0)

@@ -1,0 +1,146 @@
+"""Pallas row-routing kernel: the whole tree's split log in one pass.
+
+The XLA form of ``assign_leaves`` (learner.py) walks the split log with a
+254-round ``fori_loop``, each round a full-N elementwise pass — ~30 ms/tree
+at 2M rows (the per-round fusions are small and latency-bound). This kernel
+streams each row tile through VMEM ONCE and applies all rounds in-register:
+HBM traffic drops to one read of the transposed binned matrix plus one
+write of the leaf vector, and the per-round work is a handful of VPU ops on
+a resident (rows/128, 128) tile (~5 ms/tree).
+
+Scope: numerical splits, with or without EFB bundles (all per-round
+quantities reduce to SMEM scalars). Categorical splits need a per-row
+(B,)-table lookup — those trees fall back to the XLA router.
+
+Reference analog: Tree::PredictLeafIndex over pre-binned data
+(src/io/tree.cpp), used for score updates via the data partition
+(score_updater.hpp:88).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+try:  # pallas is optional at import time (CPU meshes use the XLA path)
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover
+    pl = pltpu = None
+
+# SMEM table layout: per round r the columns are
+#   0 col      matrix column to read (bundle group or feature)
+#   1 leaf     leaf id split this round
+#   2 bin      threshold bin (feature-space)
+#   3 miss     movable-missing bin (-1: none)
+#   4 dl       default-left flag
+#   5 plain    1 = no bundle arithmetic for this column
+#   6 off      bundle: sub-feature's slot offset
+#   7 dpos     bundle: shared default-bin slot position
+#   8 nbm1     bundle: sub-feature slots (num_bins - 1)
+#   9 rest     bundle: direction of out-of-range slots
+TBL_W = 10
+ROUTE_BLOCK_ROWS = 16384  # rows per grid block (shared with assign_leaves)
+
+
+def _route_kernel(sref, binst_ref, out_ref, *, rounds, csub, num_feat):
+    i32 = jnp.int32
+    num_splits = sref[0]
+    state = jnp.zeros((csub, 128), i32)
+
+    def body(r, state):
+        base = 1 + r * TBL_W
+        col_idx = sref[base + 0]
+        leaf = sref[base + 1]
+        tbin = sref[base + 2]
+        miss = sref[base + 3]
+        dl = sref[base + 4]
+        plain = sref[base + 5]
+        off = sref[base + 6]
+        dpos = sref[base + 7]
+        nbm1 = sref[base + 8]
+        rest = sref[base + 9]
+        col = binst_ref[col_idx].astype(i32)           # (csub, 128)
+        # bundle slot -> feature bin (identity when plain): slots above the
+        # shared default position shift down by one. All routing flags stay
+        # in i32 0/1 form — Mosaic cannot truncate i8 vectors to i1 data.
+        rank = col - off
+        fb = rank + jnp.clip(rank - dpos + 1, 0, 1)    # +1 when rank >= dpos
+        in_r = jnp.clip(col - off + 1, 0, 1) \
+            * jnp.clip(off + nbm1 - col, 0, 1)         # 1 when in range
+        eff = jnp.where(plain == 1, col, fb)
+        go = jnp.clip(tbin - eff + 1, 0, 1)            # 1 when eff <= tbin
+        is_miss = 1 - jnp.clip(jnp.abs(eff - miss), 0, 1)
+        go = jnp.where((miss >= 0) & (is_miss == 1), dl, go)
+        go = jnp.where((plain == 1) | (in_r == 1), go, rest)
+        upd = jnp.where((state == leaf) & (go == 0), r + 1, state)
+        return jnp.where(r < num_splits, upd, state)
+
+    state = jax.lax.fori_loop(0, rounds, body, state)
+    out_ref[:, :] = state
+
+
+def route_rows(bins_t: jax.Array, table: jax.Array, num_splits: jax.Array,
+               n: int, *, rows_per_block: int = ROUTE_BLOCK_ROWS
+               ) -> jax.Array:
+    """(F, Npad/128, 128) u8 tiles + (R*TBL_W,) i32 table -> (Npad,) i32.
+
+    ``bins_t`` must be the transposed binned matrix reshaped to
+    (F, Npad/128, 128) with Npad a multiple of rows_per_block; padding rows
+    route harmlessly (callers slice [:n]).
+    """
+    num_feat, nsub, _ = bins_t.shape
+    rounds = (table.shape[0]) // TBL_W
+    csub = rows_per_block // 128
+    assert nsub % csub == 0, (nsub, csub)
+    grid = nsub // csub
+    scalars = jnp.concatenate([num_splits.reshape(1).astype(jnp.int32),
+                               table.astype(jnp.int32)])
+    kern = partial(_route_kernel, rounds=rounds, csub=csub,
+                   num_feat=num_feat)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(grid,),
+        in_specs=[pl.BlockSpec((num_feat, csub, 128),
+                               lambda i, s: (0, i, 0))],
+        out_specs=pl.BlockSpec((csub, 128), lambda i, s: (i, 0)),
+    )
+    out = pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((nsub, 128), jnp.int32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+    )(scalars, bins_t)
+    return out.reshape(-1)
+
+
+def build_route_table(log, meta, bundle: Optional[dict]) -> jax.Array:
+    """Assemble the per-round SMEM scalar table from a TreeLog (in-graph;
+    all gathers are over (R,)-sized arrays)."""
+    r_iota = jnp.arange(log.split_leaf.shape[0], dtype=jnp.int32)
+    feat = log.feature
+    if bundle is not None:
+        colv = bundle["group"][feat]
+        plain = ~bundle["has_rest"][feat]
+        off = bundle["offset"][feat]
+        dpos = bundle["dpos"][feat]
+        nbm1 = bundle["nbm1"][feat]
+        rest = jnp.take_along_axis(
+            log.go_left, dpos[:, None], axis=1)[:, 0]
+    else:
+        colv = feat
+        plain = jnp.ones_like(feat, dtype=bool)
+        off = jnp.zeros_like(feat)
+        dpos = jnp.zeros_like(feat)
+        nbm1 = jnp.zeros_like(feat)
+        rest = jnp.zeros_like(feat, dtype=bool)
+    miss = jnp.where(log.movable, log.miss_bin, -1)
+    cols = [colv, log.split_leaf, log.bin, miss,
+            log.default_left.astype(jnp.int32), plain.astype(jnp.int32),
+            off, dpos, nbm1, rest.astype(jnp.int32)]
+    del r_iota, meta
+    return jnp.stack([c.astype(jnp.int32) for c in cols],
+                     axis=1).reshape(-1)
