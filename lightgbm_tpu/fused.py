@@ -90,7 +90,12 @@ def _obj_static_fp(obj) -> str:
     operand (python scalars, np arrays — these embed in the trace). Array
     pytrees contribute their structure + leaf signatures only."""
     items = []
+    skip = getattr(obj, "fp_skip_attrs", ())
     for k in sorted(vars(obj)):
+        if k in skip:
+            # host mirrors of device operands: never read by traced code,
+            # and hashing 2M-row arrays per block fingerprint is waste
+            continue
         v = getattr(obj, k)
         if _is_array_tree(v):
             sig = [(str(a.shape), str(a.dtype)) for a in jax.tree.leaves(v)]

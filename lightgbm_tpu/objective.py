@@ -72,6 +72,11 @@ class ObjectiveFunction:
     need_renew = False
     is_ranking = False
 
+    # attributes that only mirror device operands (or derive from
+    # already-fingerprinted config/metadata): the fused-block fingerprint
+    # skips hashing their N-sized contents
+    fp_skip_attrs = frozenset({"_label_host", "_weight_host"})
+
     def __init__(self, config: Config) -> None:
         self.config = config
         self.label: Optional[jax.Array] = None
@@ -84,6 +89,10 @@ class ObjectiveFunction:
             if metadata.label is not None else None
         self.weight = jnp.asarray(metadata.weight, jnp.float32) \
             if metadata.weight is not None else None
+        # host mirrors: _label_np/_weight_np must not round-trip through
+        # the device (a device_get through the tunnel costs seconds at 2M)
+        self._label_host = metadata.label
+        self._weight_host = metadata.weight
 
     # objectives that draw per-iteration randomness take a traced iteration
     # index in get_gradients (see RankXENDCG)
@@ -107,9 +116,13 @@ class ObjectiveFunction:
 
     # host mirrors for metric/renew paths
     def _label_np(self) -> np.ndarray:
+        if getattr(self, "_label_host", None) is not None:
+            return self._label_host
         return np.asarray(self.label)
 
     def _weight_np(self) -> Optional[np.ndarray]:
+        if getattr(self, "_weight_host", None) is not None:
+            return self._weight_host
         return None if self.weight is None else np.asarray(self.weight)
 
 
@@ -125,8 +138,9 @@ class RegressionL2(ObjectiveFunction):
         super().init(metadata)
         if self.config.reg_sqrt:
             lab = self._label_np()
-            self._raw_label = lab
-            self.label = jnp.asarray(np.sign(lab) * np.sqrt(np.abs(lab)), jnp.float32)
+            trans = (np.sign(lab) * np.sqrt(np.abs(lab))).astype(np.float32)
+            self.label = jnp.asarray(trans)
+            self._label_host = trans  # keep the host mirror in sync
 
     def get_gradients(self, score):
         g = score - self.label
@@ -256,6 +270,7 @@ class RegressionMAPE(RegressionL2):
         w = self._weight_np()
         self._label_weight = lw if w is None else lw * w
         self.weight = None  # folded into label_weight
+        self._weight_host = None  # mirror must track self.weight
 
     def get_gradients(self, score):
         lw = jnp.asarray(self._label_weight, jnp.float32)
@@ -522,6 +537,9 @@ class LambdarankNDCG(ObjectiveFunction):
     the reference's per-query double loop."""
     name = "lambdarank"
     is_ranking = True
+    # _gains_np derives from label + label_gain (both fingerprinted); the
+    # bucket tables it feeds ride as jit operands
+    fp_skip_attrs = ObjectiveFunction.fp_skip_attrs | {"_gains_np"}
 
     def init(self, metadata: Metadata) -> None:
         super().init(metadata)
@@ -658,6 +676,9 @@ class RankXENDCG(ObjectiveFunction):
     name = "rank_xendcg"
     is_ranking = True
     needs_iter = True
+    # _doc_idx_np mirrors the doc_idx jit operand (derives from the
+    # fingerprinted query boundaries)
+    fp_skip_attrs = ObjectiveFunction.fp_skip_attrs | {"_doc_idx_np"}
 
     def init(self, metadata: Metadata) -> None:
         super().init(metadata)
