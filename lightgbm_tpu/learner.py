@@ -245,7 +245,8 @@ def _make_best_for(meta: FeatureMeta, hp: SplitHyper, key, feature_mask,
 
     def best_for(r, leaf, hist, parent_sum, parent_out, lower, upper,
                  used_row, extra_mask=None, want_feature_gains=False,
-                 use_hp=None, cegb_delta=None, node_depth=None):
+                 use_hp=None, cegb_delta=None, node_depth=None,
+                 adv_bounds=None):
         fmask, rand_thr = node_inputs(r, leaf)
         fmask = fmask & allowed_mask(used_row)
         if extra_mask is not None:
@@ -254,7 +255,8 @@ def _make_best_for(meta: FeatureMeta, hp: SplitHyper, key, feature_mask,
             hist, parent_sum, meta, fmask, use_hp if use_hp is not None else hp,
             parent_output=parent_out, leaf_lower=lower, leaf_upper=upper,
             rand_threshold=rand_thr, want_feature_gains=want_feature_gains,
-            cegb_delta=cegb_delta, node_depth=node_depth)
+            cegb_delta=cegb_delta, node_depth=node_depth,
+            adv_bounds=adv_bounds)
 
     return best_for
 
@@ -472,6 +474,123 @@ def build_tree(
 
 
 
+# ---------------------------------------------------------------------------
+# Advanced monotone constraints (reference: monotone_constraints.hpp:856
+# AdvancedLeafConstraints). The reference walks the tree per split to
+# collect piecewise per-threshold bounds; the TPU-native form keeps DENSE
+# state — per-leaf per-feature per-bin bound arrays (L, F, B) plus bin-range
+# boxes (L, F) — and refreshes ALL leaves vectorized at every commit: each
+# new child broadcasts its output as a bound to every leaf whose box
+# overlaps the child's in all other features, over the bins beyond the
+# child's own range in each monotone dimension. Candidate-threshold bounds
+# then come from prefix/suffix extrema of the bin arrays, so the split scan
+# sees per-threshold constraints exactly where the reference recomputes
+# them. Sound by construction (every committed output is sandwiched against
+# all earlier overlapping neighbors); tighter than `intermediate`, which
+# collapses each leaf's constraints to two scalars.
+
+
+def _adv_init(num_leaves: int, num_feat: int, num_bin: int, meta):
+    cons_lo = jnp.full((num_leaves, num_feat, num_bin), -jnp.inf, jnp.float32)
+    cons_hi = jnp.full((num_leaves, num_feat, num_bin), jnp.inf, jnp.float32)
+    rng_lo = jnp.zeros((num_leaves, num_feat), jnp.int32)
+    rng_hi = jnp.broadcast_to(meta.num_bins[None, :],
+                              (num_leaves, num_feat)).astype(jnp.int32)
+    return (cons_lo, cons_hi, rng_lo, rng_hi)
+
+
+def _adv_bounds_of(adv, leaf):
+    """Per-candidate child bounds (lo_l, up_l, lo_r, up_r), each (F, B):
+    entry [f, t] bounds the child of a split on feature f at threshold t
+    (left = bins <= t)."""
+    cons_lo, cons_hi, rng_lo, rng_hi = adv
+    lo = cons_lo[leaf]
+    hi = cons_hi[leaf]                                # (F, B)
+    rlo = rng_lo[leaf]
+    rhi = rng_hi[leaf]                                # (F,)
+    num_bin = lo.shape[1]
+    b = jnp.arange(num_bin, dtype=jnp.int32)[None, :]
+    inr = (b >= rlo[:, None]) & (b < rhi[:, None])
+    hi_m = jnp.where(inr, hi, jnp.inf)
+    lo_m = jnp.where(inr, lo, -jnp.inf)
+    hi_f = jnp.min(hi_m, axis=1)                      # (F,) whole-range bound
+    lo_f = jnp.max(lo_m, axis=1)
+    # min/max over all features EXCEPT f (two-extremum trick)
+    hi_s = jnp.sort(hi_f)
+    hi1, hi2 = hi_s[0], hi_s[min(1, hi_f.shape[0] - 1)]
+    hi_exc = jnp.where((hi_f == hi1) & (jnp.sum(hi_f == hi1) == 1), hi2, hi1)
+    lo_s = jnp.sort(lo_f)
+    lo1, lo2 = lo_s[-1], lo_s[max(lo_f.shape[0] - 2, 0)]
+    lo_exc = jnp.where((lo_f == lo1) & (jnp.sum(lo_f == lo1) == 1), lo2, lo1)
+    # prefix extrema cover the left child's bins [0, t]; suffix (shifted
+    # one left) the right child's bins (t, B)
+    pre_hi = jax.lax.cummin(hi_m, axis=1)
+    pre_lo = jax.lax.cummax(lo_m, axis=1)
+    suf_hi = jnp.flip(jax.lax.cummin(jnp.flip(hi_m, 1), axis=1), 1)
+    suf_lo = jnp.flip(jax.lax.cummax(jnp.flip(lo_m, 1), axis=1), 1)
+    inf_c = jnp.full((hi_m.shape[0], 1), jnp.inf)
+    suf_hi = jnp.concatenate([suf_hi[:, 1:], inf_c], axis=1)
+    suf_lo = jnp.concatenate([suf_lo[:, 1:], -inf_c], axis=1)
+    up_l = jnp.minimum(hi_exc[:, None], pre_hi)
+    lo_l = jnp.maximum(lo_exc[:, None], pre_lo)
+    up_r = jnp.minimum(hi_exc[:, None], suf_hi)
+    lo_r = jnp.maximum(lo_exc[:, None], suf_lo)
+    return lo_l, up_l, lo_r, up_r
+
+
+def _adv_child_boxes(rng_lo, rng_hi, sel, leaf, new_leaf, info):
+    """Split the parent's bin box along a numerical winner's feature and
+    commit the children's boxes. Returns the updated (rng_lo, rng_hi) plus
+    the two child boxes (left keeps the parent's slot)."""
+    is_num = info.kind == 0
+    fs = info.feature
+    t1 = info.bin + 1
+    p_rlo = rng_lo[leaf]
+    p_rhi = rng_hi[leaf]
+    rhi_l = p_rhi.at[fs].set(jnp.where(is_num, t1, p_rhi[fs]))
+    rlo_r = p_rlo.at[fs].set(jnp.where(is_num, t1, p_rlo[fs]))
+    rng_lo = rng_lo.at[new_leaf].set(sel(rlo_r, rng_lo[new_leaf]))
+    rng_hi = rng_hi.at[leaf].set(sel(rhi_l, p_rhi)) \
+        .at[new_leaf].set(sel(p_rhi, rng_hi[new_leaf]))
+    return rng_lo, rng_hi, (p_rlo, rhi_l), (rlo_r, p_rhi)
+
+
+def _adv_overlap_except(rng_lo, rng_hi, c_rlo, c_rhi):
+    """(L, F) mask: leaf boxes overlapping child box C in every feature BUT
+    the column's own (the dimension a bound would apply along)."""
+    ov = (rng_lo < c_rhi[None, :]) & (c_rlo[None, :] < rng_hi)
+    nfalse = jnp.sum(~ov, axis=1)
+    return (nfalse == 0)[:, None] | ((nfalse == 1)[:, None] & ~ov)
+
+
+def _adv_commit(adv, meta, sel, leaf, new_leaf, info, num_bin: int):
+    """Split commit: children inherit the parent's constraint entries, the
+    split feature's box tightens (numerical winners), and both children
+    broadcast their outputs as bounds to every box-overlapping leaf along
+    every monotone dimension."""
+    cons_lo, cons_hi, rng_lo, rng_hi = adv
+    cons_lo = cons_lo.at[new_leaf].set(sel(cons_lo[leaf], cons_lo[new_leaf]))
+    cons_hi = cons_hi.at[new_leaf].set(sel(cons_hi[leaf], cons_hi[new_leaf]))
+    rng_lo, rng_hi, box_l, box_r = _adv_child_boxes(
+        rng_lo, rng_hi, sel, leaf, new_leaf, info)
+    b = jnp.arange(num_bin, dtype=jnp.int32)[None, None, :]
+    mono = meta.monotone[None, :]
+    inc = (mono > 0)[:, :, None]
+    dec = (mono < 0)[:, :, None]
+    valid_b = sel(jnp.bool_(True), jnp.bool_(False))
+    for (c_rlo, c_rhi), out in ((box_l, info.left_output),
+                                (box_r, info.right_output)):
+        ov_exc = _adv_overlap_except(rng_lo, rng_hi, c_rlo, c_rhi)
+        below = b < c_rlo[None, :, None]
+        above = b >= c_rhi[None, :, None]
+        hi_upd = (inc & below) | (dec & above)
+        lo_upd = (inc & above) | (dec & below)
+        gate = ov_exc[:, :, None] & valid_b
+        cons_hi = jnp.where(gate & hi_upd, jnp.minimum(cons_hi, out), cons_hi)
+        cons_lo = jnp.where(gate & lo_upd, jnp.maximum(cons_lo, out), cons_lo)
+    return (cons_lo, cons_hi, rng_lo, rng_hi)
+
+
 def build_tree_partitioned(
     bins: jax.Array,          # (N, F) uint8 — row shard on this device
     ghc: jax.Array,           # (N, 3) f32 (grad, hess, inbag) — masked already
@@ -636,7 +755,7 @@ def build_tree_partitioned(
             + meta.cegb_coupled * (~tree_used).astype(jnp.float32))
 
     def node_best(r, leaf, hg, tot_g, tot_l, parent_out, lower, upper,
-                  used_row, tree_used, depth):
+                  used_row, tree_used, depth, adv_b=None):
         """Best split for a node under the active comm strategy. ``hg`` is
         the (bundled) histogram — global for serial/data/feature, LOCAL for
         voting; ``tot_g``/``tot_l`` the node's global/local (g,h,cnt)."""
@@ -644,7 +763,7 @@ def build_tree_partitioned(
         if not voting:
             info = best_raw(r, leaf, feat_view(hg, tot_g), tot_g, parent_out,
                             lower, upper, used_row, cegb_delta=delta,
-                            node_depth=depth)
+                            node_depth=depth, adv_bounds=adv_b)
             return comm.sync_split(info)
         # ---- voting parallel (reference: GlobalVoting,
         # voting_parallel_tree_learner.cpp:151,322) ----
@@ -669,7 +788,7 @@ def build_tree_partitioned(
         selmask = jnp.any(selmat > 0.5, axis=0)
         return best_raw(r, leaf, full, tot_g, parent_out, lower, upper,
                         used_row, extra_mask=selmask, cegb_delta=delta,
-                        node_depth=depth)
+                        node_depth=depth, adv_bounds=adv_b)
 
     # ---- init: root ----
     root_sum_loc = jnp.sum(ghc, axis=0)
@@ -694,12 +813,21 @@ def build_tree_partitioned(
     leaf_cnt = jnp.zeros((num_leaves,), jnp.int32).at[0].set(n)
     leaf_parity = jnp.zeros((num_leaves,), jnp.int32)
     tree_used0 = cegb_used.astype(bool)
+    if hp.mono_advanced:
+        adv0 = _adv_init(num_leaves, num_feat, num_bin, meta)
+    elif hp.has_monotone and hp.mono_intermediate:
+        # intermediate's neighbor refresh needs only the (L, F) bin boxes
+        adv0 = _adv_init(num_leaves, num_feat, num_bin, meta)[2:]
+    else:
+        adv0 = ()
     best = _empty_best(num_leaves, num_bin)
     best = _set_best(best, 0,
                      node_best(0, jnp.int32(0), root_hist, root_sum,
                                root_sum_loc, leaf_out[0], leaf_lower[0],
                                leaf_upper[0], leaf_used[0], tree_used0,
-                               jnp.int32(0)))
+                               jnp.int32(0),
+                               *((_adv_bounds_of(adv0, jnp.int32(0)),)
+                                 if hp.mono_advanced else ())))
     log = TreeLog(
         num_splits=jnp.int32(0),
         split_leaf=jnp.zeros((max_splits,), jnp.int32),
@@ -723,14 +851,19 @@ def build_tree_partitioned(
             return jnp.bool_(True)
         return depth < max_depth
 
-    node_best_pair = jax.vmap(
-        node_best, in_axes=(None, 0, 0, 0, 0, 0, 0, 0, None, None, None))
+    if hp.mono_advanced:
+        node_best_pair = jax.vmap(
+            node_best, in_axes=(None, 0, 0, 0, 0, 0, 0, 0, None, None,
+                                None, 0))
+    else:
+        node_best_pair = jax.vmap(
+            node_best, in_axes=(None, 0, 0, 0, 0, 0, 0, 0, None, None, None))
 
     force_live = jnp.bool_(n_forced > 0)
     carry0 = (jnp.int32(0), work, leaf_start, leaf_cnt, leaf_parity,
               hist_pool, leaf_sum, leaf_sum_loc, leaf_out, leaf_depth,
               leaf_lower, leaf_upper, best, log, leaf_used, tree_used0,
-              force_live)
+              force_live, adv0)
 
     def cond(carry):
         r, best, log, force_live = carry[0], carry[12], carry[13], carry[16]
@@ -741,7 +874,7 @@ def build_tree_partitioned(
     def body(carry):
         (r, work, leaf_start, leaf_cnt, leaf_parity, hist_pool, leaf_sum,
          leaf_sum_loc, leaf_out, leaf_depth, leaf_lower, leaf_upper, best,
-         log, leaf_used, tree_used, force_live) = carry
+         log, leaf_used, tree_used, force_live, adv) = carry
         leaf = jnp.argmax(best.gain).astype(jnp.int32)
         info: SplitInfo = jax.tree.map(lambda a: a[leaf], best)
         if n_forced:
@@ -778,6 +911,32 @@ def build_tree_partitioned(
                 lambda _: (leaf, info, jnp.bool_(False)), operand=None)
         s = log.num_splits
         new_leaf = s + 1
+
+        if hp.has_monotone and (hp.mono_intermediate or hp.mono_advanced):
+            # the stored best split was evaluated under the bounds current
+            # at the leaf's LAST evaluation; neighbor refreshes may have
+            # tightened them since. The reference re-searches affected
+            # leaves (GoDownToFindLeavesToUpdate -> RecomputeBestSplit);
+            # we keep the chosen split but re-clamp its outputs against the
+            # parent's CURRENT bounds and re-enforce sibling order — the
+            # committed values then respect every earlier neighbor, which
+            # is what the soundness induction needs.
+            mono_f = meta.monotone[info.feature]
+            if hp.mono_advanced:
+                lo_l, up_l, lo_r, up_r = _adv_bounds_of(adv, leaf)
+                wl = jnp.clip(info.left_output,
+                              lo_l[info.feature, info.bin],
+                              up_l[info.feature, info.bin])
+                wr = jnp.clip(info.right_output,
+                              lo_r[info.feature, info.bin],
+                              up_r[info.feature, info.bin])
+            else:
+                lo_p, up_p = leaf_lower[leaf], leaf_upper[leaf]
+                wl = jnp.clip(info.left_output, lo_p, up_p)
+                wr = jnp.clip(info.right_output, lo_p, up_p)
+            swap = ((mono_f > 0) & (wl > wr)) | ((mono_f < 0) & (wl < wr))
+            wl, wr = jnp.where(swap, wr, wl), jnp.where(swap, wl, wr)
+            info = info._replace(left_output=wl, right_output=wr)
 
         if n_forced:
             valid = info.gain > -jnp.inf
@@ -845,17 +1004,49 @@ def build_tree_partitioned(
         d = leaf_depth[leaf] + 1
         leaf_depth = leaf_depth.at[leaf].set(sel(d, leaf_depth[leaf])) \
             .at[new_leaf].set(sel(d, leaf_depth[new_leaf]))
-        if hp.has_monotone:
+        if hp.has_monotone and hp.mono_advanced:
+            pass  # per-threshold bounds handled via _adv_commit below
+        elif hp.has_monotone and hp.mono_intermediate:
+            # intermediate: children inherit the parent's scalar bounds,
+            # then BOTH children broadcast their committed outputs as
+            # bounds to every box-overlapping leaf wholly below/above them
+            # in each monotone dimension. The broadcast includes the
+            # sibling constraint (left is wholly below right on the split
+            # feature) AND the reference's neighbor refresh
+            # (monotone_constraints.hpp:463 GoDownToFindLeavesToUpdate) —
+            # without which a neighbor's later sub-split can drop below an
+            # earlier committed output (observed monotonicity violations).
+            lo_p, up_p = leaf_lower[leaf], leaf_upper[leaf]
+            leaf_lower = leaf_lower.at[new_leaf].set(
+                sel(lo_p, leaf_lower[new_leaf]))
+            leaf_upper = leaf_upper.at[new_leaf].set(
+                sel(up_p, leaf_upper[new_leaf]))
+            rng_lo, rng_hi = adv
+            rng_lo, rng_hi, box_l, box_r = _adv_child_boxes(
+                rng_lo, rng_hi, sel, leaf, new_leaf, info)
+            adv = (rng_lo, rng_hi)
+            monov = meta.monotone[None, :]                  # (1, F)
+            inc = monov > 0
+            dec = monov < 0
+            valid_b = sel(jnp.bool_(True), jnp.bool_(False))
+            for (c_rlo, c_rhi), out in ((box_l, info.left_output),
+                                        (box_r, info.right_output)):
+                ov_exc = _adv_overlap_except(rng_lo, rng_hi, c_rlo, c_rhi)
+                below = rng_hi <= c_rlo[None, :]            # wholly below C
+                above = rng_lo >= c_rhi[None, :]            # wholly above C
+                hi_m = jnp.any(ov_exc & ((inc & below) | (dec & above)),
+                               axis=1) & valid_b            # (L,)
+                lo_m = jnp.any(ov_exc & ((inc & above) | (dec & below)),
+                               axis=1) & valid_b
+                leaf_upper = jnp.where(hi_m, jnp.minimum(leaf_upper, out),
+                                       leaf_upper)
+                leaf_lower = jnp.where(lo_m, jnp.maximum(leaf_lower, out),
+                                       leaf_lower)
+        elif hp.has_monotone:
+            # basic bounds both children by the split midpoint (reference:
+            # monotone_constraints.hpp:327 BasicLeafConstraints)
             mono = meta.monotone[info.feature]
-            # basic bounds both children by the split midpoint; intermediate
-            # bounds each child by the sibling's output — tighter, giving
-            # better-quality constrained trees (reference:
-            # monotone_constraints.hpp:327 Basic vs :463 Intermediate)
-            if hp.mono_intermediate:
-                bl = info.right_output   # left child's bound
-                br = info.left_output    # right child's bound
-            else:
-                bl = br = (info.left_output + info.right_output) * 0.5
+            bl = br = (info.left_output + info.right_output) * 0.5
             lo_l, up_l = leaf_lower[leaf], leaf_upper[leaf]
             new_up_l = jnp.where(mono > 0, jnp.minimum(up_l, bl), up_l)
             new_lo_r = jnp.where(mono > 0, jnp.maximum(lo_l, br), lo_l)
@@ -903,11 +1094,19 @@ def build_tree_partitioned(
         # one vmapped search over both children: the scan ops are tiny at
         # (F, B), so two separate calls pay the per-op dispatch cost twice
         pair = jnp.stack([leaf, new_leaf])
+        extra_pair = ()
+        if hp.mono_advanced:
+            adv = _adv_commit(adv, meta, sel, leaf, new_leaf, info, num_bin)
+            ab_l = _adv_bounds_of(adv, leaf)
+            ab_r = _adv_bounds_of(adv, new_leaf)
+            extra_pair = (jax.tree.map(lambda a, b: jnp.stack([a, b]),
+                                       ab_l, ab_r),)
         infos = node_best_pair(
             r, pair, jnp.stack([hist_left, hist_right]),
             jnp.stack([info.left_sum, info.right_sum]),
             jnp.stack([loc_left, loc_right]), leaf_out[pair],
-            leaf_lower[pair], leaf_upper[pair], used_new, tree_used, d)
+            leaf_lower[pair], leaf_upper[pair], used_new, tree_used, d,
+            *extra_pair)
         gates = jnp.stack([depth_ok(leaf_depth[leaf]),
                            depth_ok(leaf_depth[new_leaf])]) & valid
         infos = infos._replace(gain=jnp.where(gates, infos.gain, -jnp.inf))
@@ -919,11 +1118,11 @@ def build_tree_partitioned(
 
         return (r + 1, work, leaf_start, leaf_cnt, leaf_parity, hist_pool,
                 leaf_sum, leaf_sum_loc, leaf_out, leaf_depth, leaf_lower,
-                leaf_upper, best, log, leaf_used, tree_used, force_live)
+                leaf_upper, best, log, leaf_used, tree_used, force_live, adv)
 
     carry = jax.lax.while_loop(cond, body, carry0)
     (_, work_fin, _, _, _, _, leaf_sum, _, leaf_out, _, _, _, _, log, _, _,
-     _) = carry
+     _, _) = carry
     row_leaf = assign_leaves(bins, log, has_categorical=hp.has_categorical,
                              bundle=bundle, bins_t=bins_t)
     log = log._replace(leaf_value=leaf_out, leaf_sum=leaf_sum,
@@ -1126,6 +1325,8 @@ class SerialTreeLearner:
             has_monotone=dataset.monotone_constraints is not None,
             mono_intermediate=config.monotone_constraints_method
             in ("intermediate", "advanced"),
+            mono_advanced=(config.monotone_constraints_method == "advanced"
+                           and dataset.monotone_constraints is not None),
             monotone_penalty=float(config.monotone_penalty),
             cegb_tradeoff=float(config.cegb_tradeoff),
             cegb_penalty_split=float(config.cegb_penalty_split),
@@ -1135,10 +1336,6 @@ class SerialTreeLearner:
             use_cegb=bool(config.cegb_penalty_split > 0
                           or config.cegb_penalty_feature_coupled),
         )
-        if config.monotone_constraints_method == "advanced":
-            Log.warning("monotone_constraints_method=advanced is not "
-                        "implemented; using intermediate")
-
         self.bins = jnp.asarray(dataset.binned)
         self.num_bin_hist = int(max(2, dataset.group_num_bins().max()
                                     if dataset.num_groups else 2))
@@ -1146,6 +1343,11 @@ class SerialTreeLearner:
         if dataset.has_bundles:
             self.bundle = {k: jnp.asarray(v)
                            for k, v in dataset.bundle_maps().items()}
+        if self.hp.mono_advanced and not self.use_partition():
+            Log.warning("monotone_constraints_method=advanced needs the "
+                        "partitioned builder (max_bin <= 256); the dense "
+                        "builder applies the basic (midpoint) method")
+            self.hp = self.hp._replace(mono_advanced=False)
         if self.hp.use_cegb and not self.use_partition():
             Log.fatal("CEGB penalties require the partitioned builder "
                       "(max_bin <= 256, tree_builder != dense)")

@@ -66,6 +66,11 @@ class SplitHyper(NamedTuple):
     # split midpoint; intermediate by the sibling's output
     # (reference: monotone_constraints.hpp:327 Basic, :463 Intermediate)
     mono_intermediate: bool = False
+    # advanced: per-threshold piecewise bounds per (leaf, feature) with an
+    # all-leaf refresh at every commit (reference: AdvancedLeafConstraints,
+    # monotone_constraints.hpp:856 — reformulated as dense (L, F, B) bound
+    # arrays + (L, F) bin-range boxes instead of pointer-walking)
+    mono_advanced: bool = False
     # gain multiplier for splits on monotone features, decaying with leaf
     # depth (reference: monotone_constraints.hpp:355
     # ComputeMonotoneSplitGainPenalty)
@@ -130,11 +135,14 @@ def leaf_objective_value(g, h, hp: SplitHyper):
 
 def _split_gain_pair(gl, hl, cl, gr, hr, cr, hp: SplitHyper, *,
                      extra_l2=0.0, parent_output=0.0, lower=None, upper=None,
-                     monotone=None):
+                     monotone=None, child_bounds=None):
     """Gain of a candidate split + the (possibly constrained) child outputs.
 
     Broadcasts over any leading shape. Returns (gain, w_left, w_right,
-    constraint_ok)."""
+    constraint_ok). ``child_bounds``, when given, carries per-candidate
+    (lower_l, upper_l, lower_r, upper_r) arrays (the advanced monotone
+    method's per-threshold constraints); it overrides the shared
+    [lower, upper] clamp."""
     wl = calc_leaf_output(gl, hl, hp, extra_l2)
     wr = calc_leaf_output(gr, hr, hp, extra_l2)
     wl = _smoothed(wl, cl, parent_output, hp)
@@ -145,7 +153,15 @@ def _split_gain_pair(gl, hl, cl, gr, hr, cr, hp: SplitHyper, *,
         # must respect the feature's direction and the leaf's inherited bounds
         viol = ((monotone > 0) & (wl > wr)) | ((monotone < 0) & (wl < wr))
         ok = ok & ~viol
-        if lower is not None:
+        if child_bounds is not None:
+            lo_l, up_l, lo_r, up_r = child_bounds
+            wl = jnp.clip(wl, lo_l, up_l)
+            wr = jnp.clip(wr, lo_r, up_r)
+            # per-child bounds can invert the sibling order after clamping
+            # (the shared-clamp path cannot); re-check on clamped outputs
+            viol2 = ((monotone > 0) & (wl > wr)) | ((monotone < 0) & (wl < wr))
+            ok = ok & ~viol2
+        elif lower is not None:
             wl = jnp.clip(wl, lower, upper)
             wr = jnp.clip(wr, lower, upper)
     gain = _gain_given_output(gl, hl, wl, hp, extra_l2) + \
@@ -167,6 +183,9 @@ def find_best_split(
     want_feature_gains: bool = False,
     cegb_delta: Optional[jax.Array] = None,      # (F,) CEGB gain penalties
     node_depth: Optional[jax.Array] = None,      # scalar i32 leaf depth
+    adv_bounds=None,  # advanced monotone: (lo_l, up_l, lo_r, up_r) (F, B)
+    # per-candidate child bounds (reference: monotone_constraints.hpp:856
+    # AdvancedLeafConstraints — per-threshold constraints in the scan)
 ) -> SplitInfo:
     """Best split over all features for one leaf's histogram.
 
@@ -193,7 +212,8 @@ def find_best_split(
         gain, _, _, ok = _split_gain_pair(
             gl, hl, cl, gr, hr, cr, hp,
             parent_output=parent_output, lower=leaf_lower, upper=leaf_upper,
-            monotone=meta.monotone[:, None] if hp.has_monotone else None)
+            monotone=meta.monotone[:, None] if hp.has_monotone else None,
+            child_bounds=adv_bounds)
         ok = ok & (cl >= hp.min_data_in_leaf) & (cr >= hp.min_data_in_leaf) \
             & (hl >= hp.min_sum_hessian_in_leaf) & (hr >= hp.min_sum_hessian_in_leaf)
         return jnp.where(ok, gain - parent_gain, NEG_INF)
@@ -335,8 +355,13 @@ def find_best_split(
     wr = _smoothed(calc_leaf_output(right_sum[0], right_sum[1], hp, extra),
                    right_sum[2], parent_output, hp)
     if hp.has_monotone:
-        wl = jnp.clip(wl, leaf_lower, leaf_upper)
-        wr = jnp.clip(wr, leaf_lower, leaf_upper)
+        if adv_bounds is not None:
+            lo_l, up_l, lo_r, up_r = adv_bounds
+            wl = jnp.clip(wl, lo_l[feat, tbin], up_l[feat, tbin])
+            wr = jnp.clip(wr, lo_r[feat, tbin], up_r[feat, tbin])
+        else:
+            wl = jnp.clip(wl, leaf_lower, leaf_upper)
+            wr = jnp.clip(wr, leaf_lower, leaf_upper)
 
     valid = best_gain > jnp.float32(hp.min_gain_to_split)
     best_gain = jnp.where(valid, best_gain, NEG_INF)

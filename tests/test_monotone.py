@@ -1,0 +1,73 @@
+"""Monotone constraint methods: soundness and quality ordering.
+
+Reference semantics: all three methods GUARANTEE monotone predictions;
+basic is the most constraining (split midpoint bounds), intermediate and
+advanced are progressively less constraining and so fit no worse
+(reference: monotone_constraints.hpp:327 Basic, :463 Intermediate,
+:856 AdvancedLeafConstraints; docs/Parameters.rst monotone_constraints_method).
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.basic import Booster
+
+
+def _data(seed=7, n=5000):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, 4)
+    y = 1.6 * X[:, 0] - 1.1 * X[:, 1] + np.sin(X[:, 2] * 6) * X[:, 3] \
+        + 0.12 * rng.randn(n)
+    return X, y
+
+
+def _train(X, y, method, rounds=15):
+    return lgb.train({"objective": "regression", "num_leaves": 63,
+                      "verbosity": -1,
+                      "monotone_constraints": [1, -1, 0, 0],
+                      "monotone_constraints_method": method,
+                      "tpu_iter_block": 5},
+                     lgb.Dataset(X, label=y), num_boost_round=rounds)
+
+
+def _worst_slope(bst, feature, sign, reps=25, seed=3):
+    rng = np.random.RandomState(seed)
+    grid = np.linspace(0.01, 0.99, 50)
+    worst = 0.0
+    for _ in range(reps):
+        pts = np.tile(rng.rand(4), (50, 1))
+        pts[:, feature] = grid
+        p = bst.predict(pts) * sign
+        worst = min(worst, float(np.diff(p).min()))
+    return worst
+
+
+@pytest.mark.parametrize("method", ["basic", "intermediate", "advanced"])
+def test_monotone_soundness(method):
+    X, y = _data()
+    bst = _train(X, y, method)
+    # feature 0 increasing, feature 1 decreasing — no violated slope anywhere
+    assert _worst_slope(bst, 0, +1) >= -1e-7
+    assert _worst_slope(bst, 1, -1) >= -1e-7
+
+
+def test_method_quality_ordering():
+    X, y = _data()
+    l2 = {}
+    for m in ("basic", "intermediate", "advanced"):
+        bst = _train(X, y, m)
+        l2[m] = float(np.mean((bst.predict(X) - y) ** 2))
+    # less-constraining methods fit at least as well (small slack for f32)
+    assert l2["intermediate"] <= l2["basic"] * 1.02
+    assert l2["advanced"] <= l2["basic"] * 1.02
+
+
+def test_advanced_enabled_no_downgrade():
+    X, y = _data(n=1200)
+    b = Booster(params={"objective": "regression", "num_leaves": 15,
+                        "verbosity": -1,
+                        "monotone_constraints": [1, 0, 0, 0],
+                        "monotone_constraints_method": "advanced"},
+                train_set=lgb.Dataset(X, label=y))
+    hp = b.inner.learner.hp
+    assert hp.mono_advanced and hp.has_monotone
