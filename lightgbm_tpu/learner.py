@@ -490,13 +490,18 @@ def build_tree(
 # collapses each leaf's constraints to two scalars.
 
 
-def _adv_init(num_leaves: int, num_feat: int, num_bin: int, meta):
-    cons_lo = jnp.full((num_leaves, num_feat, num_bin), -jnp.inf, jnp.float32)
-    cons_hi = jnp.full((num_leaves, num_feat, num_bin), jnp.inf, jnp.float32)
+def _adv_boxes_init(num_leaves: int, num_feat: int, meta):
+    """(L, F) bin-range boxes — all intermediate mode needs."""
     rng_lo = jnp.zeros((num_leaves, num_feat), jnp.int32)
     rng_hi = jnp.broadcast_to(meta.num_bins[None, :],
                               (num_leaves, num_feat)).astype(jnp.int32)
-    return (cons_lo, cons_hi, rng_lo, rng_hi)
+    return (rng_lo, rng_hi)
+
+
+def _adv_init(num_leaves: int, num_feat: int, num_bin: int, meta):
+    cons_lo = jnp.full((num_leaves, num_feat, num_bin), -jnp.inf, jnp.float32)
+    cons_hi = jnp.full((num_leaves, num_feat, num_bin), jnp.inf, jnp.float32)
+    return (cons_lo, cons_hi) + _adv_boxes_init(num_leaves, num_feat, meta)
 
 
 def _adv_bounds_of(adv, leaf):
@@ -515,12 +520,13 @@ def _adv_bounds_of(adv, leaf):
     lo_m = jnp.where(inr, lo, -jnp.inf)
     hi_f = jnp.min(hi_m, axis=1)                      # (F,) whole-range bound
     lo_f = jnp.max(lo_m, axis=1)
-    # min/max over all features EXCEPT f (two-extremum trick)
-    hi_s = jnp.sort(hi_f)
-    hi1, hi2 = hi_s[0], hi_s[min(1, hi_f.shape[0] - 1)]
+    # min/max over all features EXCEPT f (two-extremum trick; the +/-inf
+    # sentinel makes the "no other features" case — F == 1 — unconstrained)
+    hi_s = jnp.sort(jnp.concatenate([hi_f, jnp.array([jnp.inf])]))
+    hi1, hi2 = hi_s[0], hi_s[1]
     hi_exc = jnp.where((hi_f == hi1) & (jnp.sum(hi_f == hi1) == 1), hi2, hi1)
-    lo_s = jnp.sort(lo_f)
-    lo1, lo2 = lo_s[-1], lo_s[max(lo_f.shape[0] - 2, 0)]
+    lo_s = jnp.sort(jnp.concatenate([lo_f, jnp.array([-jnp.inf])]))
+    lo1, lo2 = lo_s[-1], lo_s[-2]
     lo_exc = jnp.where((lo_f == lo1) & (jnp.sum(lo_f == lo1) == 1), lo2, lo1)
     # prefix extrema cover the left child's bins [0, t]; suffix (shifted
     # one left) the right child's bins (t, B)
@@ -817,7 +823,7 @@ def build_tree_partitioned(
         adv0 = _adv_init(num_leaves, num_feat, num_bin, meta)
     elif hp.has_monotone and hp.mono_intermediate:
         # intermediate's neighbor refresh needs only the (L, F) bin boxes
-        adv0 = _adv_init(num_leaves, num_feat, num_bin, meta)[2:]
+        adv0 = _adv_boxes_init(num_leaves, num_feat, meta)
     else:
         adv0 = ()
     best = _empty_best(num_leaves, num_bin)
@@ -899,7 +905,9 @@ def build_tree_partitioned(
                     parent_output=leaf_out[fl], leaf_lower=leaf_lower[fl],
                     leaf_upper=leaf_upper[fl],
                     rand_threshold=jnp.full((num_feat,), f_bin[ri], jnp.int32),
-                    node_depth=leaf_depth[fl])
+                    node_depth=leaf_depth[fl],
+                    adv_bounds=(_adv_bounds_of(adv, fl)
+                                if hp.mono_advanced else None))
                 ok = fi.gain > -jnp.inf
                 return (jnp.where(ok, fl, leaf),
                         jax.tree.map(lambda a, b: jnp.where(ok, a, b), fi, info),

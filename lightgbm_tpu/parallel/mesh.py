@@ -160,14 +160,22 @@ class _MeshTreeLearner(SerialTreeLearner):
         log = self._build(self.bins, ghc, self.meta, feature_mask, key,
                           cegb_used)
         if multiproc:
-            # row_leaf comes back globally sharded; a non-addressable global
-            # array cannot be sliced on host — gather this process's
-            # addressable shards and trim the padding
-            rows = np.concatenate(
-                [np.asarray(sh.data)
+            # row_leaf comes back globally sharded; this process's score
+            # updates need only its LOCAL rows. Collect the addressable
+            # shards onto one local device and concatenate THERE — the
+            # previous np.asarray round-trip moved O(local rows) through
+            # the host on EVERY tree
+            dev0 = jax.local_devices()[0]
+            rows = jnp.concatenate(
+                [jax.device_put(sh.data, dev0)
                  for sh in sorted(log.row_leaf.addressable_shards,
                                   key=lambda sh: sh.index[0].start or 0)])
-            log = log._replace(row_leaf=jnp.asarray(rows[:n]))
+            # leaf_value is consumed by the process-local score update: a
+            # globally-replicated array cannot mix with the single-device
+            # score (it is tiny — a host hop is fine)
+            log = log._replace(
+                row_leaf=rows[:n],
+                leaf_value=jax.device_put(np.asarray(log.leaf_value), dev0))
         elif self.rows_sharded and self.padded_n != n:
             log = log._replace(row_leaf=log.row_leaf[:n])
         return log
