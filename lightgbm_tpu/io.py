@@ -339,7 +339,8 @@ def load_dataset_two_round(filename: str, config: Config,
 
 
 def load_dataset_sharded(filename: str, config: Config, rank: Optional[int] = None,
-                         world: Optional[int] = None, sample_gather=None):
+                         world: Optional[int] = None, sample_gather=None,
+                         count_gather=None):
     """Per-host sharded dataset loading (reference: the distributed loader,
     src/io/dataset_loader.cpp:182,951 — each rank reads its row partition,
     bin mappers are found from globally-gathered samples so every rank owns
@@ -402,16 +403,24 @@ def load_dataset_sharded(filename: str, config: Config, rank: Optional[int] = No
     feature_names = [header_names[c] for c in used_cols] if header_names \
         else None
 
-    # pass 1: count data rows (stream, no parsing)
-    n_total = 0
-    with open(filename) as f:
-        for _ in range(skip):
-            f.readline()
-        for line in f:
-            if line.strip():
-                n_total += 1
-    r0 = rank * n_total // world
-    r1 = (rank + 1) * n_total // world
+    if config.pre_partition:
+        # the file IS this rank's partition already (reference:
+        # config.h pre_partition; dataset_loader.cpp LoadFromFile skips
+        # the row modulo-split when is_pre_partition) — keep every row;
+        # no counting pass needed
+        n_total = -1
+        r0, r1 = 0, np.iinfo(np.int64).max
+    else:
+        # pass 1: count data rows (stream, no parsing)
+        n_total = 0
+        with open(filename) as f:
+            for _ in range(skip):
+                f.readline()
+            for line in f:
+                if line.strip():
+                    n_total += 1
+        r0 = rank * n_total // world
+        r1 = (rank + 1) * n_total // world
 
     # pass 2: stream; keep only [r0, r1); reservoir-sample the local slice
     target = max(2, int(config.bin_construct_sample_cnt) // world)
@@ -446,6 +455,8 @@ def load_dataset_sharded(filename: str, config: Config, rank: Optional[int] = No
             n_samp += m
     X_local = np.concatenate(locals_X) if locals_X else \
         np.zeros((0, len(used_cols)))
+    if config.pre_partition:
+        n_total = seen  # pass 2 counted the local file; world>1 gathers below
     local_sample = sample[:min(target, n_samp)]
 
     if sample_gather is None:
@@ -472,14 +483,16 @@ def load_dataset_sharded(filename: str, config: Config, rank: Optional[int] = No
         change = np.flatnonzero(np.diff(gc)) + 1
         group = np.diff(np.concatenate([[0], change, [len(gc)]]))
     elif os.path.exists(filename + ".query"):
-        if world > 1:
+        # pre-partitioned files own complete query sets, so their sidecars
+        # apply verbatim; only the rank row-split cannot honor sidecars
+        if world > 1 and not config.pre_partition:
             Log.fatal("sharded loading with a .query sidecar is not "
                       "supported (query sizes cannot be split per rank); "
                       "use a group_column instead")
         group = np.loadtxt(filename + ".query", dtype=np.int64).ravel()
     wfile = filename + ".weight"
     if not locals_w and os.path.exists(wfile):
-        if world > 1:
+        if world > 1 and not config.pre_partition:
             Log.fatal("sharded loading with a .weight sidecar is not "
                       "supported; use a weight_column instead")
         locals_w = [np.loadtxt(wfile, dtype=np.float64).ravel()]
@@ -492,5 +505,18 @@ def load_dataset_sharded(filename: str, config: Config, rank: Optional[int] = No
     ds.binned = _extract_binned(X_local, ds,
                                 nthreads=int(config.num_threads))
     ds.raw_numeric = None
+    if config.pre_partition and world > 1:
+        # pre-partitioned files may be unequal; the mesh assembles uniform
+        # per-process blocks, so publish a capacity of world * max(local).
+        # Padding rows carry zero gradients/hessians/counts and never
+        # affect histograms or splits.
+        if count_gather is None:
+            from jax.experimental import multihost_utils
+
+            def count_gather(x):
+                return multihost_utils.process_allgather(x)
+        counts = np.asarray(count_gather(
+            np.full((1,), len(X_local), np.float64))).ravel()
+        n_total = int(counts.max()) * world
     ds.shard_info = (int(rank), int(world), int(n_total))
     return ds

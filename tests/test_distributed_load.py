@@ -109,3 +109,44 @@ def test_sharded_group_column(tmp_path):
         assert ds.num_features == 4          # qid column not a feature
         assert ds.metadata.query_boundaries is not None
         assert ds.metadata.num_queries == 20
+
+
+def test_pre_partitioned_files(tmp_path):
+    """pre_partition=true: each rank's file IS its partition (reference:
+    config.h pre_partition; the loader skips the rank row-split). Unequal
+    shards publish a world*max capacity so the mesh's uniform per-process
+    blocks can hold every rank."""
+    rng = np.random.RandomState(5)
+    sizes = [600, 400]
+    world = 2
+    Xs, paths = [], []
+    for r, sz in enumerate(sizes):
+        X = rng.normal(size=(sz, 5))
+        y = (X[:, 0] > 0).astype(np.float64)
+        f = tmp_path / f"part{r}.csv"
+        np.savetxt(f, np.column_stack([y, X]), delimiter=",", fmt="%.8g")
+        Xs.append(X)
+        paths.append(str(f))
+    params = {"pre_partition": True, "verbosity": -1,
+              "bin_construct_sample_cnt": 4000}
+
+    def gather(local):
+        return np.concatenate(Xs)  # global reservoir sample
+
+    def counts(local):
+        return np.asarray([float(s) for s in sizes])
+
+    shards = [load_dataset_sharded(paths[r], Config.from_params(params),
+                                   rank=r, world=world, sample_gather=gather,
+                                   count_gather=counts)
+              for r in range(world)]
+    for r, ds in enumerate(shards):
+        assert ds.num_data == sizes[r]
+        assert ds.binned.shape[0] == sizes[r]
+        # capacity = world * max local rows
+        assert ds.shard_info == (r, world, world * max(sizes))
+    # identical mappers on both ranks (same global sample)
+    b0 = [m.upper_bounds for m in shards[0].bin_mappers]
+    b1 = [m.upper_bounds for m in shards[1].bin_mappers]
+    for a, b in zip(b0, b1):
+        np.testing.assert_allclose(a, b)
