@@ -422,8 +422,14 @@ def load_dataset_sharded(filename: str, config: Config, rank: Optional[int] = No
         r0 = rank * n_total // world
         r1 = (rank + 1) * n_total // world
 
-    # pass 2: stream; keep only [r0, r1); reservoir-sample the local slice
-    target = max(2, int(config.bin_construct_sample_cnt) // world)
+    # pass 2: stream; keep only [r0, r1); reservoir-sample the local slice.
+    # pre_partition ranks sample the FULL budget from their own file — the
+    # reference's behavior (dataset_loader.cpp:909 samples sample_cnt when
+    # num_machines == 1 || pre_partition, no per-rank division)
+    if config.pre_partition:
+        target = max(2, int(config.bin_construct_sample_cnt))
+    else:
+        target = max(2, int(config.bin_construct_sample_cnt) // world)
     rng = np.random.RandomState(config.data_random_seed + rank)
     sample = np.empty((target, len(used_cols)), np.float64)
     n_samp = 0
@@ -458,6 +464,15 @@ def load_dataset_sharded(filename: str, config: Config, rank: Optional[int] = No
     if config.pre_partition:
         n_total = seen  # pass 2 counted the local file; world>1 gathers below
     local_sample = sample[:min(target, n_samp)]
+    if world > 1 and len(local_sample) < target:
+        # the default allgather needs identical shapes on every rank; a
+        # shard shorter than the budget pads by cycling its own rows (its
+        # whole shard is already in the sample, so weighting is unchanged
+        # relative to the reference's full-file sample of a short file)
+        if len(local_sample) == 0:
+            Log.fatal("rank %d: no data rows in %s", rank, filename)
+        reps = -(-target // len(local_sample))
+        local_sample = np.tile(local_sample, (reps, 1))[:target]
 
     if sample_gather is None:
         if world > 1:

@@ -63,6 +63,7 @@ def test_binary_codegen_exact(tmp_path):
 
 
 @needs_gxx
+@pytest.mark.slow
 def test_multiclass_codegen_exact(tmp_path):
     rng = np.random.RandomState(1)
     X = rng.normal(size=(1500, 5))

@@ -93,6 +93,7 @@ def test_lambdarank_example_quality():
     assert ndcg > 0.65
 
 
+@pytest.mark.slow
 def test_cli_matches_python_api(tmp_path):
     """CLI config-file training and python-API training with the same
     parameters produce the same model (the reference's consistency bar)."""

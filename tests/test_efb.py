@@ -40,6 +40,7 @@ def test_bundles_shrink_columns(rng):
     assert maps["proj"].shape[0] == ds.num_features
 
 
+@pytest.mark.slow
 def test_bundled_training_matches_unbundled(rng):
     X, y = _onehot_blocks(rng, 4000)
     params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
@@ -68,6 +69,7 @@ def test_sparse_input_binning_matches_dense(rng):
     np.testing.assert_array_equal(ds_sp.binned, ds_dn.binned)
 
 
+@pytest.mark.slow
 def test_valid_set_shares_bundling(rng):
     X, y = _onehot_blocks(rng, 3000)
     Xtr, ytr = X[:2000], y[:2000]

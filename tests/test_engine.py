@@ -76,6 +76,7 @@ def test_multiclass(rng):
     assert float(np.mean(np.argmax(p, 1) == y)) > 0.92
 
 
+@pytest.mark.slow
 def test_multiclassova(rng):
     X = rng.randn(1500, 6)
     y = ((X[:, 0] > 0).astype(int) + (X[:, 1] > 0.3).astype(int))
@@ -171,6 +172,7 @@ def test_dart(rng):
     assert mse < 0.35 * float(np.var(y))
 
 
+@pytest.mark.slow
 def test_rf(rng):
     X, y = _bin_data(rng)
     bst = lgb.train({**BASE, "objective": "binary", "boosting": "rf",
@@ -219,6 +221,7 @@ def test_weights(rng):
     assert err[X[:, 0] > 0].mean() < err[X[:, 0] <= 0].mean()
 
 
+@pytest.mark.slow
 def test_cv(rng):
     X, y = _bin_data(rng)
     res = lgb.cv({**BASE, "objective": "binary", "metric": ["auc"]},

@@ -43,7 +43,7 @@ def _both(rec, tag):
     return out
 
 
-def _run_binary():
+def _run_binary(rounds=20):
     X, y, _, _ = _data("binary_classification", "binary.train")
     Xt, yt, _, _ = _data("binary_classification", "binary.test")
     ds = lgb.Dataset(X, label=y)
@@ -51,13 +51,13 @@ def _run_binary():
     rec = {}
     lgb.train({"objective": "binary", "num_leaves": 31, "learning_rate": 0.1,
                "metric": ["auc", "binary_logloss"], "verbose": -1}, ds,
-              num_boost_round=20, valid_sets=[ds, dt],
+              num_boost_round=rounds, valid_sets=[ds, dt],
               valid_names=["training", "test"],
               callbacks=[lgb.record_evaluation(rec)])
     return _both(rec, "binary")
 
 
-def _run_multiclass():
+def _run_multiclass(rounds=15):
     X, y, _, _ = _data("multiclass_classification", "multiclass.train")
     Xt, yt, _, _ = _data("multiclass_classification", "multiclass.test")
     ds = lgb.Dataset(X, label=y)
@@ -65,13 +65,13 @@ def _run_multiclass():
     rec = {}
     lgb.train({"objective": "multiclass", "num_class": 5, "num_leaves": 31,
                "learning_rate": 0.05, "metric": ["multi_logloss"],
-               "verbose": -1}, ds, num_boost_round=15, valid_sets=[ds, dt],
+               "verbose": -1}, ds, num_boost_round=rounds, valid_sets=[ds, dt],
               valid_names=["training", "test"],
               callbacks=[lgb.record_evaluation(rec)])
     return _both(rec, "multiclass")
 
 
-def _run_lambdarank():
+def _run_lambdarank(rounds=15):
     X, y, _, grp = _data("lambdarank", "rank.train")
     Xt, yt, _, grpt = _data("lambdarank", "rank.test")
     ds = lgb.Dataset(X, label=y, group=grp)
@@ -79,34 +79,47 @@ def _run_lambdarank():
     rec = {}
     lgb.train({"objective": "lambdarank", "num_leaves": 31,
                "learning_rate": 0.1, "metric": ["ndcg"], "eval_at": [10],
-               "verbose": -1}, ds, num_boost_round=15, valid_sets=[ds, dt],
+               "verbose": -1}, ds, num_boost_round=rounds, valid_sets=[ds, dt],
               valid_names=["training", "test"],
               callbacks=[lgb.record_evaluation(rec)])
     return _both(rec, "lambdarank")
 
 
-def _collect():
+def _collect(scale=1.0):
     out = {}
-    out.update(_run_binary())
-    out.update(_run_multiclass())
-    out.update(_run_lambdarank())
+    out.update(_run_binary(rounds=max(2, int(20 * scale))))
+    out.update(_run_multiclass(rounds=max(2, int(15 * scale))))
+    out.update(_run_lambdarank(rounds=max(2, int(15 * scale))))
     return out
 
 
-@pytest.mark.skipif(not os.path.exists(GOLDEN),
-                    reason="golden_curves.json not recorded yet")
-def test_metric_curves_match_golden():
+def _check(got, full_length):
     with open(GOLDEN) as f:
         golden = json.load(f)
-    got = _collect()
     assert set(got) == set(golden), (sorted(got), sorted(golden))
     for key, want in golden.items():
         have = got[key]
-        assert len(have) == len(want), key
+        if full_length:
+            assert len(have) == len(want), key
+        want = want[:len(have)]
         diffs = np.abs(np.asarray(have) - np.asarray(want))
         assert float(diffs.max()) <= TOL, \
             "%s drifted: max |delta|=%.2e (tol %.0e)\nwant %s\ngot  %s" % (
                 key, diffs.max(), TOL, want[:5], have[:5])
+
+
+@pytest.mark.skipif(not os.path.exists(GOLDEN),
+                    reason="golden_curves.json not recorded yet")
+def test_metric_curve_prefixes_match_golden():
+    """Fast gate: half-length trainings against the recorded prefixes."""
+    _check(_collect(scale=0.5), full_length=False)
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(not os.path.exists(GOLDEN),
+                    reason="golden_curves.json not recorded yet")
+def test_metric_curves_match_golden():
+    _check(_collect(), full_length=True)
 
 
 if __name__ == "__main__":

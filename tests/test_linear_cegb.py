@@ -5,10 +5,12 @@ cost_effective_gradient_boosting.hpp:66 DetlaGain,
 monotone_constraints.hpp:327/:463 Basic/Intermediate.
 """
 import numpy as np
+import pytest
 
 import lightgbm_tpu as lgb
 
 
+@pytest.mark.slow
 def test_linear_tree_beats_plain_on_piecewise_linear(rng):
     n = 3000
     X = rng.rand(n, 4) * 4

@@ -17,6 +17,7 @@ import subprocess
 import sys
 
 import numpy as np
+import pytest
 
 from tests.conftest import clean_cpu_env
 
@@ -75,6 +76,7 @@ def _free_port():
     return port
 
 
+@pytest.mark.slow
 def test_two_process_data_parallel(tmp_path, rng):
     n, f = 4000, 8
     X = rng.randn(n, f)

@@ -131,6 +131,7 @@ def test_voting_wide_features(rng):
     assert auc > 0.9
 
 
+@pytest.mark.slow
 def test_parallel_launcher():
     """On axon terminals, run this module's mesh tests in a subprocess with
     a clean CPU environment (the in-process backend cannot be switched)."""

@@ -38,7 +38,9 @@ def _consumed_names() -> set:
     names = set()
     for fname, src in _iter_sources():
         if fname.endswith(".cpp"):
-            # native sources: fall back to identifier tokens
+            # native sources: identifier tokens with comments stripped
+            # (a parameter named only in a C++ comment is not consumed)
+            src = re.sub(r"//[^\n]*|/\*.*?\*/", "", src, flags=re.S)
             names.update(re.findall(r"[A-Za-z_][A-Za-z0-9_]*", src))
             continue
         tree = ast.parse(src)

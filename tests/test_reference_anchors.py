@@ -49,6 +49,7 @@ def test_binary_breast_cancer_anchor():
     assert ret < 0.14, ret  # reference bar (test_engine.py test_binary)
 
 
+@pytest.mark.slow
 def test_multiclass_digits_anchor():
     sklearn = pytest.importorskip("sklearn")
     from sklearn.datasets import load_digits

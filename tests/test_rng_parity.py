@@ -44,6 +44,7 @@ def test_eager_fused_identical(extra):
     assert sf == se, "fused and eager models differ under %r" % (extra,)
 
 
+@pytest.mark.slow
 def test_balanced_bagging_parity():
     X, y = _data()
     params = {"objective": "binary", "num_leaves": 15, "verbose": -1,
