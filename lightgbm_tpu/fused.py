@@ -121,13 +121,18 @@ class BlockLogs(NamedTuple):
     leaf_value: jax.Array
 
 
-def _small(log: TreeLog) -> BlockLogs:
+def _small(log: TreeLog, has_categorical: bool) -> BlockLogs:
+    # go_left is only consumed for categorical splits (numerical routing
+    # rebuilds from feature/bin/default_left); dropping the (R, B) table
+    # from the per-block device->host transfer saves its payload entirely
+    # on categorical-free datasets
     return BlockLogs(
         num_splits=log.num_splits, split_leaf=log.split_leaf,
         feature=log.feature, bin=log.bin, kind=log.kind,
         default_left=log.default_left, gain=log.gain,
         left_sum=log.left_sum, right_sum=log.right_sum,
-        go_left=log.go_left, leaf_value=log.leaf_value)
+        go_left=log.go_left if has_categorical else log.go_left[:0],
+        leaf_value=log.leaf_value)
 
 
 def _seed_key(seed: int) -> jax.Array:
@@ -252,6 +257,10 @@ class FusedTrainer:
             _config_fp(g.config), _obj_static_fp(g.objective),
             str(bins.shape), str(bins.dtype), str(g.train_score.score.shape),
             lrn.num_bin_hist,
+            # hp derives from config AND dataset facts (categorical columns
+            # arrive via the Dataset API, not Config) — e.g.
+            # has_categorical shapes the traced go_left output
+            tuple(lrn.hp),
             (lrn.comm.axis, lrn.comm.mode, lrn.comm.top_k,
              lrn.comm.num_machines),
             _fp_hash(lrn.bundle), _fp_hash(lrn._forced_splits()),
@@ -318,7 +327,7 @@ class FusedTrainer:
                     score = score.at[:, c].add(upd)
                 else:
                     score = score + upd
-                logs.append(_small(log))
+                logs.append(_small(log, learner.hp.has_categorical))
             stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *logs) if K > 1 else logs[0]
             return score, cegb_used, wbuf, stacked
 
@@ -492,6 +501,7 @@ class FusedTrainer:
     def _host_tree(self, host: BlockLogs, pick):
         from .tree import Tree
         ds = self.learner.dataset
+        has_tbl = host.go_left.shape[-2] > 0
         return Tree.from_split_log(
             int(pick(host.num_splits)),
             pick(host.split_leaf), pick(host.feature), pick(host.bin),
@@ -499,6 +509,6 @@ class FusedTrainer:
             pick(host.right_sum), pick(host.leaf_value),
             bin_mappers=ds.bin_mappers,
             real_feature_index=ds.used_feature_indices,
-            go_left_table=pick(host.go_left),
+            go_left_table=pick(host.go_left) if has_tbl else None,
             is_categorical=pick(host.kind) > 0,
         )

@@ -423,13 +423,10 @@ def load_dataset_sharded(filename: str, config: Config, rank: Optional[int] = No
         r1 = (rank + 1) * n_total // world
 
     # pass 2: stream; keep only [r0, r1); reservoir-sample the local slice.
-    # pre_partition ranks sample the FULL budget from their own file — the
-    # reference's behavior (dataset_loader.cpp:909 samples sample_cnt when
-    # num_machines == 1 || pre_partition, no per-rank division)
-    if config.pre_partition:
-        target = max(2, int(config.bin_construct_sample_cnt))
-    else:
-        target = max(2, int(config.bin_construct_sample_cnt) // world)
+    # Every rank fills a uniform budget//world slot (identical allgather
+    # shapes; pooled sample bounded by the configured budget); pad rows
+    # inside a slot are dropped after the gather (see below)
+    target = max(2, int(config.bin_construct_sample_cnt) // world)
     rng = np.random.RandomState(config.data_random_seed + rank)
     sample = np.empty((target, len(used_cols)), np.float64)
     n_samp = 0
@@ -464,13 +461,53 @@ def load_dataset_sharded(filename: str, config: Config, rank: Optional[int] = No
     if config.pre_partition:
         n_total = seen  # pass 2 counted the local file; world>1 gathers below
     local_sample = sample[:min(target, n_samp)]
+    valid_rows = None
+    shard_rows = None
+    can_gather_stats = count_gather is not None \
+        or jax.process_count() == world
+    if world > 1 and config.pre_partition and not can_gather_stats:
+        Log.fatal("pre_partition sharded loading needs per-rank stats: run "
+                  "under jax.distributed with %d processes or supply "
+                  "count_gather", world)
+    if world > 1 and len(local_sample) == 0:
+        Log.fatal("rank %d: no data rows in %s", rank, filename)
+    default_gather = sample_gather is None
+    if world > 1 and can_gather_stats:
+        if count_gather is None:
+            from jax.experimental import multihost_utils
+
+            def count_gather(x):
+                return multihost_utils.process_allgather(x)
+        # per-rank (rows, samples held) — drives both the proportional
+        # sample weighting and (for pre_partition) the shard capacity
+        stats = np.asarray(count_gather(np.asarray(
+            [float(seen if config.pre_partition else len(X_local)),
+             float(len(local_sample))]))).reshape(world, 2)
+        shard_rows = stats[:, 0]
+        held = stats[:, 1].astype(np.int64)
+        if config.pre_partition:
+            # unequal shards: weight each rank's slot by its row share so
+            # the pooled quantile sample tracks the true distribution.
+            # Water-fill: ranks clipped at their held sample hand their
+            # unused entitlement to the others, keeping relative shares
+            share = shard_rows / max(shard_rows.sum(), 1.0)
+            budget = target * world
+            alloc = np.minimum(held, np.maximum(2, np.round(budget * share)))
+            for _ in range(3):
+                leftover = budget - alloc.sum()
+                room = held - alloc
+                open_share = share * (room > 0)
+                if leftover <= 0 or open_share.sum() <= 0:
+                    break
+                alloc = np.minimum(held, alloc + np.round(
+                    leftover * open_share / open_share.sum()))
+            valid_rows = alloc.astype(np.int64)
+        else:
+            valid_rows = held
     if world > 1 and len(local_sample) < target:
-        # the default allgather needs identical shapes on every rank; a
-        # shard shorter than the budget pads by cycling its own rows (its
-        # whole shard is already in the sample, so weighting is unchanged
-        # relative to the reference's full-file sample of a short file)
-        if len(local_sample) == 0:
-            Log.fatal("rank %d: no data rows in %s", rank, filename)
+        # identical allgather shapes on every rank: pad the slot by
+        # cycling local rows; with stats available the pad rows are sliced
+        # off after the gather
         reps = -(-target // len(local_sample))
         local_sample = np.tile(local_sample, (reps, 1))[:target]
 
@@ -485,6 +522,17 @@ def load_dataset_sharded(filename: str, config: Config, rank: Optional[int] = No
             def sample_gather(x):
                 return x
     global_sample = np.asarray(sample_gather(local_sample))
+    if valid_rows is not None and default_gather:
+        # drop each rank's slot padding (every rank computes the identical
+        # slice from the identical gathered stats). Only the DEFAULT
+        # gather guarantees the (world, target) slot layout; custom
+        # gathers own their sample weighting.
+        if global_sample.shape[0] != world * target:
+            Log.fatal("process_allgather returned %d sample rows, expected "
+                      "%d", global_sample.shape[0], world * target)
+        blocks = global_sample.reshape(world, target, -1)
+        global_sample = np.concatenate(
+            [blocks[r, :valid_rows[r]] for r in range(world)])
 
     # identical structure on every rank from the identical global sample
     ds = construct_dataset(global_sample, config,
@@ -524,14 +572,8 @@ def load_dataset_sharded(filename: str, config: Config, rank: Optional[int] = No
         # pre-partitioned files may be unequal; the mesh assembles uniform
         # per-process blocks, so publish a capacity of world * max(local).
         # Padding rows carry zero gradients/hessians/counts and never
-        # affect histograms or splits.
-        if count_gather is None:
-            from jax.experimental import multihost_utils
-
-            def count_gather(x):
-                return multihost_utils.process_allgather(x)
-        counts = np.asarray(count_gather(
-            np.full((1,), len(X_local), np.float64))).ravel()
-        n_total = int(counts.max()) * world
+        # affect histograms or splits. shard_rows came from the stats
+        # gather above.
+        n_total = int(shard_rows.max()) * world
     ds.shard_info = (int(rank), int(world), int(n_total))
     return ds

@@ -134,7 +134,9 @@ def test_pre_partitioned_files(tmp_path):
         return np.concatenate(Xs)  # global reservoir sample
 
     def counts(local):
-        return np.asarray([float(s) for s in sizes])
+        # (rows, samples-held) stats per rank; budget exceeds both shards,
+        # so each rank holds its whole file as its sample
+        return np.asarray([[float(s), float(s)] for s in sizes])
 
     shards = [load_dataset_sharded(paths[r], Config.from_params(params),
                                    rank=r, world=world, sample_gather=gather,
