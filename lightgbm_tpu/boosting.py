@@ -256,6 +256,10 @@ class GBDT:
                        hess: Optional[np.ndarray] = None) -> bool:
         """One boosting iteration (reference: gbdt.cpp:369 TrainOneIter).
         Returns True when no tree could be grown (all-stop signal)."""
+        # while a fused block is in flight, score already includes it but
+        # models/iter_ lag; entry points that read or extend them must
+        # finalize first so external callers never observe divergent state
+        self.finish_fused()
         it = self.iter_
         if grad is None:
             g, h = self._grad_fn(self.train_score.score, jnp.int32(it))
@@ -458,6 +462,7 @@ class GBDT:
 
     def rollback_one_iter(self) -> None:
         """(reference: gbdt.cpp:454 RollbackOneIter)"""
+        self.finish_fused()
         if self.iter_ <= 0:
             return
         for _ in range(self.num_tree_per_iteration):
@@ -624,6 +629,7 @@ class GBDT:
     # --------------------------------------------------------------- model IO
     def model_to_string(self, num_iteration: int = -1) -> str:
         """(reference: gbdt_model_text.cpp:400 SaveModelToString)"""
+        self.finish_fused()
         cfg = self.config
         K = self.num_tree_per_iteration
         total_iters = len(self.models) // max(K, 1)

@@ -16,6 +16,7 @@ goss.hpp:103) using jax.random instead of the host RNG.
 """
 from __future__ import annotations
 
+import time
 from functools import partial
 from typing import NamedTuple, Optional, Tuple
 
@@ -25,6 +26,7 @@ import numpy as np
 
 from .config import Config
 from .learner import SerialTreeLearner, TreeLog, leaf_values_by_row
+from .utils.timer import global_timer
 
 # Process-wide cache of jitted block functions. A Booster's jitted callables
 # die with the Booster, so back-to-back train() calls with identical
@@ -405,7 +407,8 @@ class FusedTrainer:
         contributed zero score in-graph via the num_splits mask), so model
         and score stay consistent for rollback/continued training."""
         gbdt = self.gbdt
-        fn = self._block_fn(k)
+        with global_timer.timed("fused/block_fn"):
+            fn = self._block_fn(k)
         prev = self._pending
         # iter_ only advances when a block is FINALIZED (keeps iter_ and
         # models consistent if finalization fails); schedule from iter_ plus
@@ -413,10 +416,11 @@ class FusedTrainer:
         it0 = gbdt.iter_ + (prev[1] if prev is not None else 0)
         pre_score = gbdt.train_score.score
         pre_used = self._used_dev()
-        (score, used), logs = fn(pre_score, pre_used,
-                                 gbdt._key, jnp.int32(it0),
-                                 self.learner.bins, self.learner.meta,
-                                 _obj_array_state(gbdt.objective))
+        with global_timer.timed("fused/dispatch"):
+            (score, used), logs = fn(pre_score, pre_used,
+                                     gbdt._key, jnp.int32(it0),
+                                     self.learner.bins, self.learner.meta,
+                                     _obj_array_state(gbdt.objective))
         gbdt.train_score.score = score
         self._cegb_used_dev = used
         # pre_score/pre_used ride along for the rollback paths below
@@ -479,7 +483,9 @@ class FusedTrainer:
         last_iter_constant = False
         trees = []
         try:
-            host = jax.device_get(logs)
+            with global_timer.timed("fused/logs_transfer"):
+                host = jax.device_get(logs)
+            t_host0 = time.perf_counter()
             for i in range(k):
                 all_constant = True
                 for c in range(K):
@@ -490,6 +496,8 @@ class FusedTrainer:
                     if tree.num_leaves > 1:
                         all_constant = False
                 last_iter_constant = all_constant
+            global_timer.add("fused/host_trees",
+                             time.perf_counter() - t_host0)
         except BaseException:
             self._rollback(pre_score, pre_used)
             raise

@@ -508,6 +508,16 @@ def load_dataset_sharded(filename: str, config: Config, rank: Optional[int] = No
         # identical allgather shapes on every rank: pad the slot by
         # cycling local rows; with stats available the pad rows are sliced
         # off after the gather
+        if not default_gather:
+            # a custom sample_gather receives the padded slot verbatim and
+            # only the default path slices the duplicates back out —
+            # duplicated rows bias quantile bin boundaries unless the
+            # caller trims to the gathered per-rank counts itself
+            Log.warning(
+                "rank %d pads its quantile sample %d -> %d rows; the "
+                "custom sample_gather sees duplicated rows (trim with the "
+                "per-rank counts from count_gather)", rank,
+                len(local_sample), target)
         reps = -(-target // len(local_sample))
         local_sample = np.tile(local_sample, (reps, 1))[:target]
 

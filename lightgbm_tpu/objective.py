@@ -90,9 +90,14 @@ class ObjectiveFunction:
         self.weight = jnp.asarray(metadata.weight, jnp.float32) \
             if metadata.weight is not None else None
         # host mirrors: _label_np/_weight_np must not round-trip through
-        # the device (a device_get through the tunnel costs seconds at 2M)
-        self._label_host = metadata.label
-        self._weight_host = metadata.weight
+        # the device (a device_get through the tunnel costs seconds at 2M).
+        # Defensive float32 COPIES: aliasing the user's buffer would let a
+        # post-construction mutation change results, and float64 mirrors
+        # would see different precision than the f32 device arrays
+        self._label_host = None if metadata.label is None \
+            else np.array(metadata.label, np.float32)
+        self._weight_host = None if metadata.weight is None \
+            else np.array(metadata.weight, np.float32)
 
     # objectives that draw per-iteration randomness take a traced iteration
     # index in get_gradients (see RankXENDCG)

@@ -215,6 +215,20 @@ def partition_segment(
 
 
 ALIGN = 32  # Mosaic requires u8 DMA row offsets provably 32-aligned
+TABLE_WORDS = 8  # (B<=256,) bool routing table bit-packed into i32 scalars
+
+
+def pack_table_bits(go_left: jax.Array) -> jax.Array:
+    """(B,) bool -> (TABLE_WORDS,) i32 bit-packed (bit b of word w = bin
+    32*w + b). Rides the kernel's scalar prefetch — full-array VMEM-spec
+    pallas inputs trigger a device-wide ~400 us/op dispatch mode."""
+    b = go_left.shape[0]
+    bits = go_left
+    if b < 32 * TABLE_WORDS:
+        bits = jnp.pad(bits, (0, 32 * TABLE_WORDS - b))
+    bits = bits.reshape(TABLE_WORDS, 32).astype(jnp.int32)
+    weights = jnp.left_shift(jnp.int32(1), jnp.arange(32, dtype=jnp.int32))
+    return jnp.sum(bits * weights[None, :], axis=1, dtype=jnp.int32)
 
 
 def work_spec(num_groups: int, quantized: bool, part_kernel: str,
@@ -234,7 +248,7 @@ def work_spec(num_groups: int, quantized: bool, part_kernel: str,
     return guard, width
 
 
-def _partition_kernel(sref, work_in, table_ref, work_ref, lt_ref,
+def _partition_kernel(sref, work_in, work_ref, lt_ref,
                       tril, cin, pre, lstage, rstage, lfb, rfb, sem,
                       *, ch, sb, width, num_bin):
     f32 = jnp.float32
@@ -363,11 +377,19 @@ def _partition_kernel(sref, work_in, table_ref, work_ref, lt_ref,
         cf = cin[slot].astype(jnp.int32).astype(f32)          # (CH, W)
         col = jnp.sum(jnp.where(lane_w == feat, cf, 0.0), axis=1,
                       keepdims=True)                          # (CH, 1)
-        # routing table lookup as a one-hot contraction over the bin axis
-        bin_l = jax.lax.broadcasted_iota(jnp.int32, (ch, num_bin), 1)
-        oh = (1 - jnp.clip(jnp.abs(bin_l - col.astype(jnp.int32)), 0, 1)) \
-            .astype(f32)
-        go = jnp.sum(oh * table_ref[:], axis=1, keepdims=True) > 0.5
+        # routing table lookup: the (B,) bool table rides the scalar
+        # prefetch as 8 bit-packed i32 words (a full-array VMEM-spec input
+        # here put the WHOLE device into a ~400 us/op dispatch mode —
+        # measured in scripts/spec_bisect.py — and poisoned every
+        # subsequent op in the process, pallas or XLA alike)
+        coli = col.astype(jnp.int32)
+        word = jax.lax.shift_right_logical(coli, 5)
+        wvals = jnp.zeros((ch, 1), jnp.int32)
+        for w in range(TABLE_WORDS):
+            wvals = jnp.where(word == w, sref[4 + w], wvals)
+        bit = jnp.bitwise_and(coli, 31)
+        go = jnp.bitwise_and(
+            jax.lax.shift_right_logical(wvals, bit), 1) > 0
         pos = sub_i + i * ch
         valid = (pos >= head) & (pos < tot)                   # (CH, 1)
 
@@ -470,7 +492,10 @@ def _partition_kernel(sref, work_in, table_ref, work_ref, lt_ref,
         return jnp.where(off < fill_l, lrows, rrows)
 
     nfull = d // ch
-    MAXT = 4  # d < 2*(ch+sb) <= 3*ch when sb <= ch/2
+    # d < 2*(ch+sb): at the default sb <= ch/2 that is <= 3*ch (nfull <= 2);
+    # at part_chunk <= 256 sb == ch and the bound is 4*ch (nfull <= 3) —
+    # MAXT must cover BOTH, so 4 is load-bearing, not slack
+    MAXT = 4
 
     def dbody(t, _):
         @pl.when(t < nfull)
@@ -554,9 +579,10 @@ def partition_segment_fused(
     if ch % sb:
         raise ValueError("partition chunk %d must be a multiple of the "
                          "sub-block %d" % (ch, sb))
-    scalars = jnp.stack([src_plane.astype(jnp.int32), start.astype(jnp.int32),
-                         cnt.astype(jnp.int32), feat.astype(jnp.int32)])
-    table = go_left.astype(jnp.float32).reshape(1, num_bin)
+    scalars = jnp.concatenate([
+        jnp.stack([src_plane.astype(jnp.int32), start.astype(jnp.int32),
+                   cnt.astype(jnp.int32), feat.astype(jnp.int32)]),
+        pack_table_bits(go_left)])
 
     kern = partial(_partition_kernel, ch=ch, sb=sb, width=width,
                    num_bin=num_bin)
@@ -565,7 +591,6 @@ def partition_segment_fused(
         grid=(1,),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.HBM),
-            pl.BlockSpec(memory_space=pltpu.VMEM),
         ],
         out_specs=[
             pl.BlockSpec(memory_space=pltpu.HBM),
@@ -591,5 +616,5 @@ def partition_segment_fused(
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",),
             vmem_limit_bytes=100 * 1024 * 1024),
-    )(scalars, work, table)
+    )(scalars, work)
     return work_out, lt[0]

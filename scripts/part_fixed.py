@@ -1,0 +1,60 @@
+"""Measure the fused partition kernel's fixed per-call cost.
+
+Chains many partition calls at several segment sizes in ONE jit; the
+per-call time vs cnt line gives (fixed, per-row) directly.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+
+from lightgbm_tpu.ops.partition import (guard_rows, pack_rows,
+                                        partition_segment_fused, work_spec)
+
+N = int(os.environ.get("PN", 1 << 21))
+F = 28
+CH = int(os.environ.get("PCH", 1024))
+REPS = 254
+
+rng = np.random.RandomState(0)
+bins = rng.randint(0, 255, size=(N, F), dtype=np.uint8)
+ghc = rng.randn(N, 3).astype(np.float32)
+guard, width = work_spec(F, False, "pallas", CH, 4096)
+pad = ((guard, guard), (0, 0))
+w0 = pack_rows(jnp.pad(jnp.asarray(bins), pad), jnp.pad(jnp.asarray(ghc), pad))
+w0 = jnp.pad(w0, ((0, 0), (0, width - w0.shape[1])))
+work = jnp.stack([w0, jnp.zeros_like(w0)])
+table = jnp.asarray(rng.rand(255) < 0.5)
+
+
+@jax.jit
+def chain(work, cnt):
+    def body(i, carry):
+        work, tot = carry
+        work, lt = partition_segment_fused(
+            work, jax.lax.rem(i, 2), jnp.int32(guard), cnt,
+            jax.lax.rem(i, F), table, ch=CH)
+        return work, tot + lt
+
+    return jax.lax.fori_loop(0, REPS, body, (work, jnp.int32(0)))
+
+
+for cnt in (256, 1024, 4096, 16384, 65536, 262144):
+    out = chain(work, jnp.int32(cnt))
+    jax.block_until_ready(out)
+    best = 1e9
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = chain(work, jnp.int32(cnt))
+        jax.block_until_ready(out)
+        best = min(best, time.perf_counter() - t0)
+    per = best / REPS * 1e6
+    print("cnt=%7d  %8.1f us/call  (%5.2f ns/row)" %
+          (cnt, per, per * 1e3 / cnt))

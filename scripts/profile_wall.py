@@ -1,0 +1,91 @@
+"""Phase breakdown of the driver-visible bench wall (VERDICT r4 item 1).
+
+Runs the binary bench shape and reports where every second goes:
+dataset construction, warmup (trace/compile vs execute), the timed train's
+dispatch / logs-transfer / host-tree phases, and the pure device time of one
+fused block (block_until_ready around the cached block fn).
+
+Usage: python scripts/profile_wall.py [N_ROWS] [N_ITER]
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 2_000_000
+ITERS = int(sys.argv[2]) if len(sys.argv) > 2 else 60
+BLOCK = int(os.environ.get("BENCH_BLOCK", 20))
+
+
+def main():
+    import jax
+    jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    t_imp0 = time.perf_counter()
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.utils.timer import global_timer
+    t_import = time.perf_counter() - t_imp0
+
+    rng = np.random.RandomState(7)
+    t0 = time.perf_counter()
+    X = rng.randn(N, 28).astype(np.float32)
+    w = rng.randn(28) / np.sqrt(28)
+    logit = X @ w + 0.5 * np.sin(X[:, 0] * 2) * X[:, 1] + 0.3 * rng.randn(N)
+    y = (logit > 0).astype(np.float64)
+    X = X.astype(np.float64)
+    t_datagen = time.perf_counter() - t0
+
+    params = {
+        "objective": "binary", "num_leaves": 255, "max_bin": 255,
+        "learning_rate": 0.1, "verbosity": -1, "metric": ["auc"],
+        "tpu_iter_block": BLOCK,
+    }
+    t0 = time.perf_counter()
+    ds = lgb.Dataset(X, label=y)
+    ds.construct()
+    t_construct = time.perf_counter() - t0
+
+    global_timer.reset()
+    t0 = time.perf_counter()
+    lgb.train(dict(params), ds, num_boost_round=BLOCK)
+    t_warmup = time.perf_counter() - t0
+    warm_t = dict(global_timer.times)
+
+    global_timer.reset()
+    t0 = time.perf_counter()
+    bst = lgb.train(dict(params), ds, num_boost_round=ITERS)
+    t_train = time.perf_counter() - t0
+    train_t = dict(global_timer.times)
+
+    # pure device time of one cached block: re-dispatch through the booster
+    # machinery and block on the result
+    global_timer.reset()
+    t0 = time.perf_counter()
+    bst2 = lgb.train(dict(params), ds, num_boost_round=BLOCK)
+    t_train1 = time.perf_counter() - t0
+    one_t = dict(global_timer.times)
+
+    t0 = time.perf_counter()
+    (_, _, auc, _), = bst.eval_train()
+    t_eval = time.perf_counter() - t0
+
+    def fmt(d):
+        return {k: round(v, 3) for k, v in sorted(d.items())}
+
+    print("== profile_wall N=%d iters=%d block=%d ==" % (N, ITERS, BLOCK))
+    print("import: %.2fs  datagen: %.2fs  construct: %.2fs" %
+          (t_import, t_datagen, t_construct))
+    print("warmup(%d it): %.2fs  %s" % (BLOCK, t_warmup, fmt(warm_t)))
+    print("train(%d it): %.2fs  %s" % (ITERS, t_train, fmt(train_t)))
+    print("train(%d it, warm): %.2fs  %s" % (BLOCK, t_train1, fmt(one_t)))
+    print("eval_train: %.2fs auc=%.4f" % (t_eval, auc))
+    acc = sum(train_t.values())
+    print("timed-train accounted: %.2fs / %.2fs (%.0f%%)" %
+          (acc, t_train, 100 * acc / max(t_train, 1e-9)))
+
+
+if __name__ == "__main__":
+    main()
