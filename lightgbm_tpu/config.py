@@ -217,6 +217,8 @@ class Config:
     #   partition kernel (TPU only) vs the portable XLA op pipeline
     tpu_hist_chunk: int = 0          # rows per segment-histogram chunk
     #   (0 = auto: 4096 for narrow matrices, 1024 for wide ones)
+    tpu_hist_kernel: str = "auto"    # auto|pallas|xla: in-VMEM Pallas
+    #   segment-histogram kernel (TPU, F <= 64) vs the XLA einsum loop
     tpu_hist_lo: int = 0             # hi/lo split width of the histogram
     #   einsum factorization (0 = auto: 4 for narrow matrices, 8 for wide;
     #   all widths are bit-identical — this is a pure layout knob)
@@ -277,6 +279,9 @@ class Config:
         if self.tpu_hist_lo not in (0, 2, 4, 8, 16):
             Log.fatal("tpu_hist_lo must be one of 0 (auto), 2, 4, 8, 16; "
                       "got %d", self.tpu_hist_lo)
+        if self.tpu_hist_kernel not in ("auto", "pallas", "xla"):
+            Log.fatal("tpu_hist_kernel must be auto, pallas or xla; got %s",
+                      self.tpu_hist_kernel)
         warned = getattr(self, "_noop_warned", None)
         if warned is None:
             warned = set()

@@ -622,6 +622,7 @@ def build_tree_partitioned(
     constraint_sets: Optional[jax.Array] = None,   # (S, F) bool
     forced: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None,
     part_kernel: str = "xla",  # xla | pallas (fused DMA kernel, TPU only)
+    hist_kernel: str = "xla",  # xla (einsum) | pallas (in-VMEM, TPU only)
     work_buf: Optional[jax.Array] = None,  # carried (2, Npad, W) u8 buffer
     return_work: bool = False,
     bins_t: Optional[jax.Array] = None,    # (F, N) transposed bins — pass a
@@ -643,7 +644,8 @@ def build_tree_partitioned(
     Same in/out contract as ``build_tree``; runs identically single-device
     or under shard_map (all collectives go through ``comm``).
     """
-    from .ops.histogram import hist16_segment, hist16_segment_q
+    from .ops.histogram import (hist16_segment, hist16_segment_q,
+                                hist_pallas_segment)
     from .ops.partition import (pack_rows, pack_rows_quantized,
                                 partition_segment, partition_segment_fused)
 
@@ -685,15 +687,25 @@ def build_tree_partitioned(
     part_fn = partition_segment_fused if fused_part else partition_segment
 
     def hist_of(work, plane, start, cnt):
+        """-> ((G, Bm, 3) reduced histogram, work). Callers must continue
+        with the RETURNED work: the pallas kernel aliases the buffer
+        through the call (identical bytes) so XLA never copies it."""
         if quantized:
             h = hist16_segment_q(work, plane, start, cnt, gscale, hscale,
                                  num_bins=bm, num_feat=num_grp,
                                  chunk=hist_chunk, lo_w=hist_lo)
+        elif hist_kernel == "pallas":
+            # in-VMEM chunk loop + accumulator: one streamed read of the
+            # segment, none of the XLA loop's per-chunk parasitic fusions
+            h, work = hist_pallas_segment(work, plane, start, cnt,
+                                          num_bins=bm, num_feat=num_grp,
+                                          exact=hist_mode != "bf16",
+                                          chunk=hist_chunk, lo_w=hist_lo)
         else:
             h = hist16_segment(work, plane, start, cnt, num_bins=bm,
                                num_feat=num_grp, exact=hist_mode != "bf16",
                                chunk=hist_chunk, lo_w=hist_lo)
-        return comm.hist(h)                               # (G, Bm, 3)
+        return comm.hist(h), work                         # (G, Bm, 3)
 
     def feat_view(hg, total_sum):
         """Bundled (G, Bm, 3) histogram -> per-feature (F, B, 3) view.
@@ -799,7 +811,8 @@ def build_tree_partitioned(
     # ---- init: root ----
     root_sum_loc = jnp.sum(ghc, axis=0)
     root_sum = comm.root(root_sum_loc)
-    root_hist = hist_of(work, jnp.int32(0), jnp.int32(guard), jnp.int32(n))
+    root_hist, work = hist_of(work, jnp.int32(0), jnp.int32(guard),
+                              jnp.int32(n))
     # the pool is kept FLAT per leaf: 4-D pools make XLA's layout
     # assignment disagree between the while carry and the gather/update
     # consumers, inserting a full pool copy per split (measured 2x430 us at
@@ -1071,7 +1084,7 @@ def build_tree_partitioned(
         left_smaller = info.left_sum[2] <= info.right_sum[2]
         small_start = jnp.where(left_smaller, start, start + lt)
         small_cnt = jnp.where(left_smaller, lt, cnt - lt)
-        hist_small = hist_of(work, new_parity, small_start, small_cnt)
+        hist_small, work = hist_of(work, new_parity, small_start, small_cnt)
         parent_hist = hist_pool[leaf].reshape(num_grp, bm, 3)
         hist_large = parent_hist - hist_small
         hist_left = jnp.where(left_smaller, hist_small, hist_large)
@@ -1454,6 +1467,21 @@ class SerialTreeLearner:
                 # at F<=64; wide matrices spill VMEM — 1024 is ~8% faster
                 # than 2048 at F=137
                 hist_chunk = 4096 if self.bins.shape[1] <= 64 else 1024
+            hist_kernel = config.tpu_hist_kernel
+            if hist_kernel == "auto":
+                # auto = xla: the in-VMEM pallas kernel is bit-identical
+                # and ~6x faster standalone, but in-situ (alternating with
+                # the partition kernel inside the tree while-loop) the axon
+                # runtime puts it on a slow dispatch path (+100 ms/iter,
+                # wall-measured A/B) that no spec variant avoided. Kept
+                # selectable for future runtimes.
+                hist_kernel = "xla"
+            elif hist_kernel == "pallas" and (part_kernel != "pallas"
+                                              or mode == "int8"):
+                Log.warning("tpu_hist_kernel=pallas needs the pallas "
+                            "partition layout and a non-quantized mode; "
+                            "using the XLA einsum")
+                hist_kernel = "xla"
             kw.update(
                 hist_chunk=hist_chunk,
                 part_chunk=part_chunk,
@@ -1462,6 +1490,7 @@ class SerialTreeLearner:
                 num_bin_hist=self.num_bin_hist,
                 bundle=self.bundle,
                 part_kernel=part_kernel,
+                hist_kernel=hist_kernel,
             )
         else:
             kw.update(

@@ -29,6 +29,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+try:  # pallas is optional at import time (CPU test meshes use XLA paths)
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover
+    pl = pltpu = None
+
 DEFAULT_CHUNK = 4096
 
 
@@ -248,6 +254,172 @@ def hist16_segment_q(work: jax.Array, plane, start, cnt, gscale, hscale, *,
     scale = jnp.stack([1.0 / gscale, 1.0 / hscale,
                        jnp.float32(1.0)])
     return h.astype(jnp.float32) * scale[None, None, :]
+
+
+# ---------------------------------------------------------------------------
+# In-VMEM Pallas segment histogram
+# ---------------------------------------------------------------------------
+#
+# The XLA einsum path below is compute-near-optimal per chunk (the one-hot
+# builds are VPU-bound), but each dynamic-trip loop iteration drags ~7.7 us
+# of parasitic fusions (operand copies, valid-mask broadcasts, accumulator
+# shuffling — profiled: copy.216 / broadcast.2689 / broadcast_multiply /
+# dynamic-slice fusions) plus XLA while-loop overhead. This kernel runs the
+# SAME hi/lo factorization with the chunk loop, channel splits and (F, SH,
+# lo_w*5) accumulator all resident in VMEM: HBM traffic is one streamed
+# read of the segment, and per-chunk overhead is one double-buffered DMA.
+# Accumulation order matches the einsum path chunk-for-chunk (bit-identical
+# at the same chunk size). Reference analog: the OpenCL histogram kernels'
+# local-memory accumulators (src/treelearner/ocl/histogram256.cl:600).
+#
+# Mosaic notes: u8 lane tiles force W % 128 == 0 (the partitioned work
+# buffer guarantees it); f32 words re-assemble from their 4 bytes with
+# MULTIPLIES (vector << by >= 16 miscompiles on this toolchain — measured);
+# one dot per feature (SH, C) x (C, lo_w*5) — pair-batching features into
+# M=128 doubles the MACs for the cross blocks and wins nothing.
+
+
+def _hist_pallas_kernel(sref, work_in, work_ref, acc_ref, cin, acc_s, sem,
+                        *, ch, width, num_feat, sh, lo_w, nch):
+    # work_ref is never written: it exists so the buffer ALIASES through
+    # this call. Without it, XLA materializes a defensive copy of the whole
+    # work buffer before every histogram (the partition kernel donates the
+    # same buffer in the same loop body) — measured +100 ms/iter at 2M rows.
+    # acc accumulates in SCRATCH and DMAs to the HBM output at the end: an
+    # ungridded VMEM-spec output (like a VMEM-spec input) drops the call
+    # onto a ~0.45 ms/call slow dispatch path.
+    f32 = jnp.float32
+    i32 = jnp.int32
+    plane = sref[0]
+    start = sref[1]
+    cnt = sref[2]
+    F = num_feat
+
+    astart = (start // 32) * 32
+    head = start - astart
+    tot = head + cnt
+    nchunks = jnp.maximum((tot + ch - 1) // ch, 1)
+
+    acc_s[...] = jnp.zeros((F * sh, lo_w * nch), f32)
+
+    def start_in(i, slot):
+        # the (x // 32) * 32 at the USE SITE is what lets Mosaic prove the
+        # u8 DMA row offset 32-aligned; an unprovable offset silently takes
+        # a ~10x slower DMA path (75 vs 7.5 us per 4096-row chunk, measured)
+        at = ((astart + i * ch) // 32) * 32
+        pltpu.make_async_copy(
+            work_in.at[plane, pl.ds(at, ch), :],
+            cin.at[slot], sem.at[slot]).start()
+
+    start_in(0, 0)
+
+    sub_i = jax.lax.broadcasted_iota(i32, (ch, 1), 0)
+    iota_sh = jax.lax.broadcasted_iota(i32, (ch, sh), 1)
+    jl = jax.lax.broadcasted_iota(i32, (ch, lo_w * nch), 1) // nch
+
+    def word(gb, o):
+        # f32 word from 4 u8 bytes; multiplies, not shifts (see above).
+        # i32 overflow of the top byte wraps to the sign bits — exactly
+        # the bit pattern the bitcast needs.
+        return jax.lax.bitcast_convert_type(
+            gb[:, o:o + 1] + gb[:, o + 1:o + 2] * 256
+            + gb[:, o + 2:o + 3] * 65536
+            + gb[:, o + 3:o + 4] * 16777216, f32)
+
+    def body(i, carry):
+        slot = jax.lax.rem(i, 2)
+        at = ((astart + i * ch) // 32) * 32
+        pltpu.make_async_copy(
+            work_in.at[plane, pl.ds(at, ch), :],
+            cin.at[slot], sem.at[slot]).wait()
+
+        @pl.when(i + 1 < nchunks)
+        def _():
+            start_in(i + 1, 1 - slot)
+
+        cw = cin[slot].astype(i32)                      # (CH, W)
+        bi = cw[:, :F]
+        hi = bi // lo_w
+        lo = bi - hi * lo_w
+        gb = cw[:, F:F + 12]
+        pos = sub_i + i * ch
+        valid = ((pos >= head) & (pos < tot)).astype(f32)
+        g = word(gb, 0) * valid
+        h = word(gb, 4) * valid
+        c = word(gb, 8) * valid
+        if nch == 5:
+            g_hi = g.astype(jnp.bfloat16)
+            g_lo = (g - g_hi.astype(f32)).astype(jnp.bfloat16)
+            h_hi = h.astype(jnp.bfloat16)
+            h_lo = (h - h_hi.astype(f32)).astype(jnp.bfloat16)
+            chs = jnp.concatenate(
+                [g_hi, g_lo, h_hi, h_lo, c.astype(jnp.bfloat16)], axis=1)
+        else:
+            chs = jnp.concatenate([g, h, c], axis=1).astype(jnp.bfloat16)
+        tiled = jnp.concatenate([chs] * lo_w, axis=1)   # (CH, lo_w*nch)
+
+        for f in range(F):
+            hioh = (hi[:, f:f + 1] == iota_sh).astype(jnp.bfloat16)
+            logf = jnp.where(lo[:, f:f + 1] == jl, tiled,
+                             jnp.bfloat16(0))
+            ps = jax.lax.dot_general(
+                hioh, logf, (((0,), (0,)), ((), ())),
+                preferred_element_type=f32)             # (SH, lo_w*nch)
+            acc_s[f * sh:(f + 1) * sh, :] += ps
+        return carry
+
+    jax.lax.fori_loop(0, nchunks, body, 0)
+    out_cp = pltpu.make_async_copy(acc_s, acc_ref, sem.at[0])
+    out_cp.start()
+    out_cp.wait()
+
+
+def hist_pallas_segment(work: jax.Array, plane, start, cnt, *,
+                        num_bins: int, num_feat: int, exact: bool = True,
+                        chunk: int = 4096, lo_w: int = 0):
+    """Pallas twin of :func:`hist16_segment` (same contract and the same
+    chunk-major f32 accumulation order). Requires the pallas-partition work
+    layout: width a multiple of 128, rows start 32-aligned +/- head.
+
+    Returns ``(hist, work)`` — callers MUST continue with the returned work
+    buffer: it is byte-identical but aliased through the call, which is what
+    keeps XLA from copying the whole buffer defensively per histogram."""
+    f = num_feat
+    lo_w = lo_w or auto_lo_w(f)
+    sh = (num_bins + lo_w - 1) // lo_w
+    nch = 5 if exact else 3
+    width = work.shape[2]
+    if width % 128:
+        raise ValueError("hist_pallas_segment needs 128-lane work rows")
+    kern = partial(_hist_pallas_kernel, ch=chunk, width=width, num_feat=f,
+                   sh=sh, lo_w=lo_w, nch=nch)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(1,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.HBM)],
+        out_specs=[pl.BlockSpec(memory_space=pltpu.HBM),
+                   pl.BlockSpec(memory_space=pltpu.HBM)],
+        scratch_shapes=[
+            pltpu.VMEM((2, chunk, width), jnp.uint8),
+            pltpu.VMEM((f * sh, lo_w * nch), jnp.float32),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    scalars = jnp.stack([plane.astype(jnp.int32), start.astype(jnp.int32),
+                         cnt.astype(jnp.int32)])
+    work_out, acc = pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct(work.shape, work.dtype),
+                   jax.ShapeDtypeStruct((f * sh, lo_w * nch), jnp.float32)],
+        input_output_aliases={1: 0},
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+            vmem_limit_bytes=100 * 1024 * 1024),
+    )(scalars, work)
+    h = _hist16_combine(acc.reshape(f, sh, lo_w * nch), num_bins, exact,
+                        lo_w)
+    return h, work_out
 
 
 def hist16_segment(work: jax.Array, plane, start, cnt, *,
