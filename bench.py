@@ -1,19 +1,20 @@
 """Benchmark: training throughput on the reference's headline workload shapes.
 
 Two workloads, mirroring the reference's published benchmark suite
-(docs/Experiments.rst:109-150, BASELINE.md):
+(docs/Experiments.rst:109-150, BASELINE.md), now at REFERENCE scale:
 
-- HIGGS-like: 28 dense numerical features, binary objective, num_leaves=255,
-  max_bin=255 — the reference's primary speed benchmark (10.5M rows, 500
-  iters, 130.094 s on a 16-core CPU = 40.4 M row*iter/s).
-- MSLR-like: 137 dense features, lambdarank objective with ~120-doc queries,
-  NDCG@10 — the reference's ranking benchmark (2.27M rows, 70.417 s =
-  16.1 M row*iter/s).
+- HIGGS-like: 10.5M rows x 28 dense numerical features, binary objective,
+  num_leaves=255, max_bin=255 — the reference's primary speed benchmark
+  (10.5M rows, 500 iters, 130.094 s on a 16-core CPU = 40.4 M row*iter/s).
+  A 2M-row run of the same shape is reported alongside (the round 1-4
+  configuration, kept for cross-round comparability).
+- MSLR-like: 2.27M rows x 137 dense features, lambdarank with ~120-doc
+  queries, NDCG@10 — the reference's ranking benchmark (2.27M rows,
+  70.417 s = 16.1 M row*iter/s).
 
-The metric is throughput in M row*iters/s at the same leaves/bins settings;
-sizes are scaled to fit a single-chip round (throughput is the comparable
-quantity). Prints ONE JSON line:
-{"metric", "value", "unit", "vs_baseline", plus secondary fields}.
+The metric is throughput in M row*iters/s at the same leaves/bins settings.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", plus
+secondary fields and a phase breakdown of this script's own wall}.
 """
 import json
 import os
@@ -22,13 +23,15 @@ import time
 
 import numpy as np
 
-N_ROWS = int(os.environ.get("BENCH_ROWS", 2_000_000))
+N_ROWS = int(os.environ.get("BENCH_ROWS", 10_500_000))
+N2_ROWS = int(os.environ.get("BENCH_ROWS_2M", 2_000_000))
 N_ITER = int(os.environ.get("BENCH_ITERS", 60))
 NUM_LEAVES = int(os.environ.get("BENCH_LEAVES", 255))
 MAX_BIN = int(os.environ.get("BENCH_MAX_BIN", 255))
-RANK_ROWS = int(os.environ.get("BENCH_RANK_ROWS", 500_000))
+RANK_ROWS = int(os.environ.get("BENCH_RANK_ROWS", 2_270_000))
 RANK_ITER = int(os.environ.get("BENCH_RANK_ITERS", 30))
 SKIP_RANK = os.environ.get("BENCH_SKIP_RANK", "") == "1"
+SKIP_2M = os.environ.get("BENCH_SKIP_2M", "") == "1"
 
 # reference CPU: Higgs 130.094 s / (500 iter * 10.5M rows); MSLR 70.417 s /
 # (500 * 2.27M)  [BASELINE.md, docs/Experiments.rst:109-123]
@@ -62,8 +65,28 @@ def make_mslr_like(n, f=137, docs_per_query=120, seed=11):
     return X.astype(np.float64), y, np.asarray(sizes, dtype=np.int64)
 
 
-def run_higgs(lgb):
-    X, y = make_higgs_like(N_ROWS)
+def _phases(timer, wall):
+    """Fused-path phase dict for one timed train + its own accounting.
+
+    dispatch = async block launches (host-side trace/launch work),
+    logs_transfer = host blocked on the device + the split-log pull,
+    host_trees = per-tree model reconstruction on host. logs_transfer is
+    where device execution surfaces (the pipeline overlaps transfer of
+    block i with execution of block i+1, so it absorbs device time)."""
+    t = timer.times
+    keys = ("fused/block_fn", "fused/dispatch", "fused/logs_transfer",
+            "fused/host_trees", "dataset construction")
+    out = {k.split("/")[-1]: round(t.get(k, 0.0), 3) for k in keys}
+    acc = sum(t.get(k, 0.0) for k in keys)
+    out["other"] = round(max(wall - acc, 0.0), 3)
+    out["accounted_pct"] = round(100.0 * min(acc / max(wall, 1e-9), 1.0), 1)
+    return out
+
+
+def run_higgs(lgb, n_rows, timer):
+    t0 = time.time()
+    X, y = make_higgs_like(n_rows)
+    t_gen = time.time() - t0
     params = {
         "objective": "binary",
         "num_leaves": NUM_LEAVES,
@@ -73,21 +96,29 @@ def run_higgs(lgb):
         "metric": ["auc"],
         "tpu_iter_block": 20,
     }
+    t0 = time.time()
     ds = lgb.Dataset(X, label=y)
+    ds.construct()
+    t_cons = time.time() - t0
     # short warmup train populates the persistent compile cache (reference
     # timings likewise exclude one-time setup)
     t0 = time.time()
     lgb.train(dict(params), ds, num_boost_round=20)
     warmup_s = time.time() - t0
+    timer.reset()
     t0 = time.time()
     bst = lgb.train(dict(params), ds, num_boost_round=N_ITER)
     train_s = time.time() - t0
+    phases = _phases(timer, train_s)
     (_, _, auc, _), = bst.eval_train()
-    return (N_ROWS * N_ITER) / train_s, auc, train_s, warmup_s
+    return ((n_rows * N_ITER) / train_s, auc, train_s, warmup_s, t_gen,
+            t_cons, phases)
 
 
-def run_mslr(lgb):
+def run_mslr(lgb, timer):
+    t0 = time.time()
     X, y, group = make_mslr_like(RANK_ROWS)
+    t_gen = time.time() - t0
     params = {
         "objective": "lambdarank",
         "num_leaves": NUM_LEAVES,
@@ -98,16 +129,22 @@ def run_mslr(lgb):
         "eval_at": [10],
         "tpu_iter_block": 10,
     }
+    t0 = time.time()
     ds = lgb.Dataset(X, label=y, group=group)
+    ds.construct()
+    t_cons = time.time() - t0
     t0 = time.time()
     lgb.train(dict(params), ds, num_boost_round=10)
     warmup_s = time.time() - t0
+    timer.reset()
     t0 = time.time()
     bst = lgb.train(dict(params), ds, num_boost_round=RANK_ITER)
     train_s = time.time() - t0
+    phases = _phases(timer, train_s)
     evals = {name: v for (_, name, v, _) in bst.eval_train()}
     ndcg = evals.get("ndcg@10", next(iter(evals.values())))
-    return (RANK_ROWS * RANK_ITER) / train_s, ndcg, train_s, warmup_s
+    return ((RANK_ROWS * RANK_ITER) / train_s, ndcg, train_s, warmup_s,
+            t_gen, t_cons, phases)
 
 
 def main():
@@ -115,26 +152,45 @@ def main():
     jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     import lightgbm_tpu as lgb
+    from lightgbm_tpu.utils.timer import global_timer
 
-    h_tp, auc, h_train, h_warm = run_higgs(lgb)
+    h_tp, auc, h_train, h_warm, h_gen, h_cons, h_ph = run_higgs(
+        lgb, N_ROWS, global_timer)
     result = {
         "metric": "higgs_like_binary_train_throughput",
         "value": round(h_tp / 1e6, 4),
         "unit": "M rows*iters/s (N=%d F=28 leaves=%d bins=%d iters=%d; "
-                "auc=%.4f; train=%.1fs warmup=%.1fs)"
-                % (N_ROWS, NUM_LEAVES, MAX_BIN, N_ITER, auc, h_train, h_warm),
+                "auc=%.4f; train=%.1fs warmup=%.1fs datagen=%.1fs "
+                "construct=%.1fs)"
+                % (N_ROWS, NUM_LEAVES, MAX_BIN, N_ITER, auc, h_train,
+                   h_warm, h_gen, h_cons),
         "vs_baseline": round(h_tp / HIGGS_BASELINE, 4),
+        "train_breakdown": h_ph,
     }
+    if not SKIP_2M:
+        try:
+            tp2, auc2, tr2, wm2, _, _, ph2 = run_higgs(lgb, N2_ROWS,
+                                                       global_timer)
+            result["value_2m"] = round(tp2 / 1e6, 4)
+            result["unit_2m"] = (
+                "M rows*iters/s (N=%d; auc=%.4f; train=%.1fs warmup=%.1fs)"
+                % (N2_ROWS, auc2, tr2, wm2))
+            result["vs_baseline_2m"] = round(tp2 / HIGGS_BASELINE, 4)
+        except Exception as e:  # pragma: no cover - report, don't fail
+            result["error_2m"] = "%s: %s" % (type(e).__name__, str(e)[:200])
     if not SKIP_RANK:
         try:
-            r_tp, ndcg, r_train, r_warm = run_mslr(lgb)
+            (r_tp, ndcg, r_train, r_warm, r_gen, r_cons,
+             r_ph) = run_mslr(lgb, global_timer)
             result["rank_value"] = round(r_tp / 1e6, 4)
             result["rank_unit"] = (
                 "M rows*iters/s (MSLR-like N=%d F=137 leaves=%d bins=%d "
-                "iters=%d; ndcg@10=%.4f; train=%.1fs warmup=%.1fs)"
+                "iters=%d; ndcg@10=%.4f; train=%.1fs warmup=%.1fs "
+                "datagen=%.1fs construct=%.1fs)"
                 % (RANK_ROWS, NUM_LEAVES, MAX_BIN, RANK_ITER, ndcg,
-                   r_train, r_warm))
+                   r_train, r_warm, r_gen, r_cons))
             result["rank_vs_baseline"] = round(r_tp / MSLR_BASELINE, 4)
+            result["rank_train_breakdown"] = r_ph
         except Exception as e:  # pragma: no cover - report, don't fail
             result["rank_error"] = "%s: %s" % (type(e).__name__, str(e)[:200])
     print(json.dumps(result))

@@ -572,8 +572,21 @@ def _adv_overlap_except(rng_lo, rng_hi, c_rlo, c_rhi):
 def _adv_commit(adv, meta, sel, leaf, new_leaf, info, num_bin: int):
     """Split commit: children inherit the parent's constraint entries, the
     split feature's box tightens (numerical winners), and both children
-    broadcast their outputs as bounds to every box-overlapping leaf along
-    every monotone dimension."""
+    broadcast their outputs as bounds to every box-overlapping leaf:
+
+    - along each MONOTONE dimension, at the bins beyond the child's own
+      range (the original dense analog of the reference's per-threshold
+      constraints, monotone_constraints.hpp:856);
+    - along each OTHER dimension f', at the bins INSIDE the child's
+      f'-range, for leaves wholly ordered against the child in some
+      monotone dimension. This second write is what separates `advanced`
+      from `intermediate`: without it, a neighbor whose bound only applies
+      to part of a leaf's f'-range (because the neighbor is itself split
+      on f') degenerates to a whole-leaf scalar clamp. The (L, F, B)
+      per-dimension representation cannot express joint restrictions over
+      several dimensions, so these writes are CONSERVATIVE (sound: only
+      ever tighter than the reference's re-searched bounds, never looser
+      than monotonicity requires)."""
     cons_lo, cons_hi, rng_lo, rng_hi = adv
     cons_lo = cons_lo.at[new_leaf].set(sel(cons_lo[leaf], cons_lo[new_leaf]))
     cons_hi = cons_hi.at[new_leaf].set(sel(cons_hi[leaf], cons_hi[new_leaf]))
@@ -583,17 +596,52 @@ def _adv_commit(adv, meta, sel, leaf, new_leaf, info, num_bin: int):
     mono = meta.monotone[None, :]
     inc = (mono > 0)[:, :, None]
     dec = (mono < 0)[:, :, None]
+    incv = mono > 0
+    decv = mono < 0
     valid_b = sel(jnp.bool_(True), jnp.bool_(False))
     for (c_rlo, c_rhi), out in ((box_l, info.left_output),
                                 (box_r, info.right_output)):
-        ov_exc = _adv_overlap_except(rng_lo, rng_hi, c_rlo, c_rhi)
+        # along-m writes apply a BLANKET per-m-bin bound over the whole
+        # leaf; that claim is precise only when C covers the leaf's box in
+        # every other dimension (always true at F == 1). When C is
+        # restricted in some free dimension, the free-dimension writes
+        # below carry the bound with its restriction instead — gating the
+        # blanket here is what lets a split on a free dimension escape a
+        # neighbor's bound outside that neighbor's range (the reference's
+        # motivating per-threshold case).
+        cover = (c_rlo[None, :] <= rng_lo) & (rng_hi <= c_rhi[None, :])
+        ncov = jnp.sum(~cover, axis=1)                             # (L,)
+        cov_exc = (ncov == 0)[:, None] | ((ncov == 1)[:, None] & ~cover)
         below = b < c_rlo[None, :, None]
         above = b >= c_rhi[None, :, None]
         hi_upd = (inc & below) | (dec & above)
         lo_upd = (inc & above) | (dec & below)
-        gate = ov_exc[:, :, None] & valid_b
+        gate = cov_exc[:, :, None] & valid_b
         cons_hi = jnp.where(gate & hi_upd, jnp.minimum(cons_hi, out), cons_hi)
         cons_lo = jnp.where(gate & lo_upd, jnp.maximum(cons_lo, out), cons_lo)
+
+        # ---- free-dimension writes (restricted to C's own bin range) ----
+        ov = (rng_lo < c_rhi[None, :]) & (c_rlo[None, :] < rng_hi)  # (L, F)
+        nonov = (~ov).astype(jnp.int32)
+        nov = jnp.sum(nonov, axis=1)                               # (L,)
+        # leaf wholly ordered against C in monotone dim m: C bounds it
+        # from above (ub) or below (lb) in value space
+        ub_ord = (incv & (rng_hi <= c_rlo[None, :])) \
+            | (decv & (rng_lo >= c_rhi[None, :]))                  # (L, F)
+        lb_ord = (incv & (rng_lo >= c_rhi[None, :])) \
+            | (decv & (rng_hi <= c_rlo[None, :]))
+        # exists an ordering dim m != f' (each ordered m is disjoint, so
+        # requiring overlap in all dims except {m, f'} is nov-nonov[f']==1)
+        ub_any = (jnp.sum(ub_ord, axis=1)[:, None]
+                  - ub_ord.astype(jnp.int32)) > 0                  # (L, F)
+        lb_any = (jnp.sum(lb_ord, axis=1)[:, None]
+                  - lb_ord.astype(jnp.int32)) > 0
+        free_gate = (nov[:, None] - nonov) == 1                    # (L, F)
+        in_rng = (b >= c_rlo[None, :, None]) & (b < c_rhi[None, :, None])
+        g_ub = (ub_any & free_gate)[:, :, None] & in_rng & valid_b
+        g_lb = (lb_any & free_gate)[:, :, None] & in_rng & valid_b
+        cons_hi = jnp.where(g_ub, jnp.minimum(cons_hi, out), cons_hi)
+        cons_lo = jnp.where(g_lb, jnp.maximum(cons_lo, out), cons_lo)
     return (cons_lo, cons_hi, rng_lo, rng_hi)
 
 

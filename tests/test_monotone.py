@@ -71,3 +71,41 @@ def test_advanced_enabled_no_downgrade():
                 train_set=lgb.Dataset(X, label=y))
     hp = b.inner.learner.hp
     assert hp.mono_advanced and hp.has_monotone
+
+
+def test_advanced_beats_intermediate_on_restricted_neighbor():
+    """The reference's motivating case for advanced constraints
+    (monotone_constraints.hpp:856): a neighbor's bound applies only to part
+    of a leaf's range along a FREE feature (the neighbor is itself split on
+    it). intermediate collapses the bound to a whole-leaf scalar and
+    over-clamps; advanced keeps it per-threshold and fits strictly better.
+
+    Construction: x0 monotone increasing, x2 free, four cells
+    (a=5, b=2 | c=9, d=4.5) with P(x2 < 0.5) = 0.1 so the x0 root split
+    wins the gain race while the bite margin a - d = 0.5 > 0 makes
+    intermediate clamp the (x0 < 0.6, x2 < 0.5) cell from 5 to 4.5."""
+    rng = np.random.RandomState(5)
+    n = 2000
+    x0 = rng.rand(n)
+    x2 = np.where(rng.rand(n) > 0.1, 0.6 + rng.rand(n) * 0.35,
+                  rng.rand(n) * 0.35)
+    y = np.where(x0 >= 0.6, np.where(x2 < 0.5, 9.0, 4.5),
+                 np.where(x2 < 0.5, 5.0, 2.0)) + 0.01 * rng.randn(n)
+    X = np.stack([x0, x2], axis=1)
+    mse = {}
+    for m in ("intermediate", "advanced"):
+        bst = lgb.train({"objective": "regression", "num_leaves": 4,
+                         "max_bin": 63, "learning_rate": 1.0,
+                         "verbosity": -1, "monotone_constraints": [1, 0],
+                         "monotone_constraints_method": m,
+                         "min_data_in_leaf": 5,
+                         "tree_builder": "partition"},
+                        lgb.Dataset(X, label=y), num_boost_round=1)
+        pred = bst.predict(X)
+        mse[m] = float(np.mean((pred - y) ** 2))
+        # monotonicity in x0 must hold for both methods
+        grid = np.linspace(0.01, 0.99, 50)
+        for x2v in (0.2, 0.8):
+            pts = np.stack([grid, np.full(50, x2v)], axis=1)
+            assert float(np.diff(bst.predict(pts)).min()) >= -1e-7, m
+    assert mse["advanced"] < mse["intermediate"] * 0.95, mse
