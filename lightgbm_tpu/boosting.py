@@ -213,7 +213,7 @@ class GBDT:
             if lab is None and self.train_set is not None \
                     and self.train_set.metadata.label is not None:
                 # custom objectives (objective=none) still bag by label
-                lab = jnp.asarray(self.train_set.metadata.label)
+                lab = self.train_set.metadata.device_label()
             # GOSS takes precedence over any bagging params (the reference's
             # data_sample_strategy switch, gbdt.cpp:228)
             if cfg.data_sample_strategy != "goss" \

@@ -82,6 +82,26 @@ class Metadata:
     def num_queries(self) -> int:
         return 0 if self.query_boundaries is None else len(self.query_boundaries) - 1
 
+    def device_label(self):
+        """Cached f32 device copy of the label (identity-keyed: set_label
+        style reassignment invalidates). See BinnedDataset.device_bins for
+        why: tunnel uploads cost seconds per 100 MB."""
+        return self._dev_cached("label")
+
+    def device_weight(self):
+        return self._dev_cached("weight")
+
+    def _dev_cached(self, name):
+        import jax.numpy as jnp
+        arr = getattr(self, name)
+        if arr is None:
+            return None
+        key = "_device_" + name + "_cache"
+        cur = getattr(self, key, None)
+        if cur is None or cur[0] is not arr:
+            setattr(self, key, (arr, jnp.asarray(arr, jnp.float32)))
+        return getattr(self, key)[1]
+
 
 @dataclass
 class FeatureGroupInfo:
@@ -121,6 +141,17 @@ class BinnedDataset:
         self.shard_info: Optional[tuple] = None
 
     # -- accessors used by the learners --
+    def device_bins(self):
+        """Device copy of the binned matrix, cached on the dataset: the
+        axon tunnel moves host arrays at ~10-30 MB/s, so re-uploading the
+        matrix per Booster cost ~10-25 s at 10.5M x 28. Identity-keyed on
+        the host array so re-binning invalidates naturally."""
+        import jax.numpy as jnp
+        cur = getattr(self, "_device_bins_cache", None)
+        if cur is None or cur[0] is not self.binned:
+            self._device_bins_cache = (self.binned, jnp.asarray(self.binned))
+        return self._device_bins_cache[1]
+
     @property
     def num_features(self) -> int:
         return len(self.bin_mappers)

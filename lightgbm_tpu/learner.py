@@ -1405,7 +1405,7 @@ class SerialTreeLearner:
             use_cegb=bool(config.cegb_penalty_split > 0
                           or config.cegb_penalty_feature_coupled),
         )
-        self.bins = jnp.asarray(dataset.binned)
+        self.bins = dataset.device_bins()
         self.num_bin_hist = int(max(2, dataset.group_num_bins().max()
                                     if dataset.num_groups else 2))
         self.bundle = None

@@ -85,10 +85,8 @@ class ObjectiveFunction:
 
     def init(self, metadata: Metadata) -> None:
         self.num_data = metadata.num_data
-        self.label = jnp.asarray(metadata.label, jnp.float32) \
-            if metadata.label is not None else None
-        self.weight = jnp.asarray(metadata.weight, jnp.float32) \
-            if metadata.weight is not None else None
+        self.label = metadata.device_label()
+        self.weight = metadata.device_weight()
         # host mirrors: _label_np/_weight_np must not round-trip through
         # the device (a device_get through the tunnel costs seconds at 2M).
         # Defensive float32 COPIES: aliasing the user's buffer would let a
