@@ -23,6 +23,17 @@ if "xla_force_host_platform_device_count" not in _flags:
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/lgb_tpu_jax_cache")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
 
+import jax  # noqa: E402
+
+# The axon sitecustomize registers its TPU plugin at interpreter start and
+# the JAX_PLATFORMS env var does NOT override it — but the config API does
+# (the backend initializes lazily at first use). LIGHTGBM_TPU_TEST_CPU=1
+# forces the suite onto the local CPU mesh; it is OFF by default because
+# on this 1-core host local execution measured SLOWER than the tunnel
+# (35-45 min vs ~25) — on any multi-core host, set it.
+if os.environ.get("LIGHTGBM_TPU_TEST_CPU"):
+    jax.config.update("jax_platforms", "cpu")
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
