@@ -606,6 +606,7 @@ class GBDT:
     def predict(self, X: np.ndarray, *, raw_score: bool = False,
                 start_iteration: int = 0, num_iteration: int = -1,
                 pred_leaf: bool = False) -> np.ndarray:
+        self.finish_fused()
         X = np.asarray(X, dtype=np.float64)
         n = X.shape[0]
         K = self.num_tree_per_iteration
@@ -684,6 +685,7 @@ class GBDT:
         a PredictRaw accumulator (init scores included) and extern-C
         single-row entry points so the file both drops into user code and
         compiles into a test harness."""
+        self.finish_fused()
         K = self.num_tree_per_iteration
         total_iters = len(self.models) // max(K, 1)
         if num_iteration is None or num_iteration <= 0:
@@ -806,6 +808,7 @@ class GBDT:
         return model
 
     def dump_json(self, num_iteration: int = -1) -> str:
+        self.finish_fused()
         K = self.num_tree_per_iteration
         total_iters = len(self.models) // max(K, 1)
         if num_iteration is None or num_iteration <= 0:
@@ -824,14 +827,17 @@ class GBDT:
 
     @property
     def current_iteration(self) -> int:
+        self.finish_fused()
         return self.iter_
 
     def num_trees(self) -> int:
+        self.finish_fused()
         return len(self.models)
 
     def feature_importance(self, importance_type: str = "split",
                            iteration: int = -1) -> np.ndarray:
         """(reference: GBDT::FeatureImportance, gbdt.cpp)"""
+        self.finish_fused()
         nf = self.train_set.num_total_features if self.train_set else (
             max((t.split_feature.max() for t in self.models
                  if t.num_leaves > 1), default=-1) + 1)

@@ -227,6 +227,12 @@ class Config:
     #   argmax-sync (vs full psum + replicated search)
     tpu_hist_precision: str = "hilo"  # hilo (~2^-17 rel, bf16 pair) |
     #   bf16 (single bf16 grads) | int8 (quantized training)
+    tpu_work_layout: str = "auto"    # auto|rows|planes: training work
+    #   buffer layout. rows = (2, Npad, W) row-major; planes = transposed
+    #   (2, W, Npad) feature-major planes — each 128-lane tile carries 128
+    #   rows of ONE byte column (no dead lanes) and the root histogram is
+    #   folded into the pack pass. auto: planes on TPU at row widths
+    #   <= 256 B, rows elsewhere. Both layouts grow bit-identical trees.
     use_quantized_grad: bool = False  # int8 stochastic gradient quantization
     #   (LightGBM 4.x quantized training analog; rows per leaf <= ~16M)
 
@@ -282,6 +288,9 @@ class Config:
         if self.tpu_hist_kernel not in ("auto", "pallas", "xla"):
             Log.fatal("tpu_hist_kernel must be auto, pallas or xla; got %s",
                       self.tpu_hist_kernel)
+        if self.tpu_work_layout not in ("auto", "rows", "planes"):
+            Log.fatal("tpu_work_layout must be auto, rows or planes; got %s",
+                      self.tpu_work_layout)
         warned = getattr(self, "_noop_warned", None)
         if warned is None:
             warned = set()

@@ -83,24 +83,38 @@ class Metadata:
         return 0 if self.query_boundaries is None else len(self.query_boundaries) - 1
 
     def device_label(self):
-        """Cached f32 device copy of the label (identity-keyed: set_label
-        style reassignment invalidates). See BinnedDataset.device_bins for
-        why: tunnel uploads cost seconds per 100 MB."""
+        """Cached f32 device copy of the label (see _dev_cached for the
+        cache key contract). Tunnel uploads cost seconds per 100 MB, so the
+        copy must not be re-made per Booster."""
         return self._dev_cached("label")
 
     def device_weight(self):
         return self._dev_cached("weight")
 
+    def bump_version(self) -> None:
+        """Invalidate every cached device copy after an IN-PLACE host
+        mutation (``meta.label[sel] = v`` style). Reassigning the attribute
+        (``meta.label = new``) invalidates by identity and does not need
+        this."""
+        self._dev_version = getattr(self, "_dev_version", 0) + 1
+
     def _dev_cached(self, name):
+        # Keyed on (array identity, version token). Identity catches
+        # attribute REASSIGNMENT; it cannot see in-place writes into the
+        # same ndarray — callers that mutate in place must bump_version(),
+        # otherwise the cached device copy is served stale. The arrays are
+        # otherwise treated as immutable once a Booster holds the dataset
+        # (the reference's set_label/set_weight APIs reassign).
         import jax.numpy as jnp
         arr = getattr(self, name)
         if arr is None:
             return None
+        ver = getattr(self, "_dev_version", 0)
         key = "_device_" + name + "_cache"
         cur = getattr(self, key, None)
-        if cur is None or cur[0] is not arr:
-            setattr(self, key, (arr, jnp.asarray(arr, jnp.float32)))
-        return getattr(self, key)[1]
+        if cur is None or cur[0] is not arr or cur[1] != ver:
+            setattr(self, key, (arr, ver, jnp.asarray(arr, jnp.float32)))
+        return getattr(self, key)[2]
 
 
 @dataclass
@@ -141,16 +155,27 @@ class BinnedDataset:
         self.shard_info: Optional[tuple] = None
 
     # -- accessors used by the learners --
+    def bump_version(self) -> None:
+        """Invalidate the cached device matrix after an IN-PLACE host write
+        into ``binned``. Rebinning (reassigning ``binned``) invalidates by
+        identity and does not need this; ``binned`` is otherwise immutable
+        once construction finishes."""
+        self._dev_version = getattr(self, "_dev_version", 0) + 1
+
     def device_bins(self):
         """Device copy of the binned matrix, cached on the dataset: the
         axon tunnel moves host arrays at ~10-30 MB/s, so re-uploading the
-        matrix per Booster cost ~10-25 s at 10.5M x 28. Identity-keyed on
-        the host array so re-binning invalidates naturally."""
+        matrix per Booster cost ~10-25 s at 10.5M x 28. Keyed on the host
+        array's identity plus the user-bumpable version token
+        (:meth:`bump_version`) — identity alone cannot see in-place writes
+        into the same ndarray."""
         import jax.numpy as jnp
+        ver = getattr(self, "_dev_version", 0)
         cur = getattr(self, "_device_bins_cache", None)
-        if cur is None or cur[0] is not self.binned:
-            self._device_bins_cache = (self.binned, jnp.asarray(self.binned))
-        return self._device_bins_cache[1]
+        if cur is None or cur[0] is not self.binned or cur[1] != ver:
+            self._device_bins_cache = (self.binned, ver,
+                                       jnp.asarray(self.binned))
+        return self._device_bins_cache[2]
 
     @property
     def num_features(self) -> int:

@@ -348,8 +348,11 @@ class FusedTrainer:
                 else:
                     sampler = make_sampler(cfg, score.shape[0])
                 # one ping-pong work buffer allocated per block and carried
-                # across the k trees (a fresh 2x(N, W) alloc+zero per tree
-                # costs ~260 MB of HBM writes at 2M rows)
+                # across the k trees (a fresh alloc+zero per tree costs
+                # ~260 MB of HBM writes at 2M rows). The spec is layout-
+                # aware: (2, Npad, W) row-major or (2, W, Npad) transposed
+                # planes (learner.work_buf_spec / tpu_work_layout) — this
+                # loop never looks inside the buffer
                 wbuf = jnp.zeros(wspec[0], wspec[1]) \
                     if wspec is not None else jnp.zeros((), jnp.uint8)
                 # transposed bins for the per-tree routing pass, computed

@@ -676,6 +676,9 @@ def build_tree_partitioned(
     bins_t: Optional[jax.Array] = None,    # (F, N) transposed bins — pass a
     # block-hoisted copy when building many trees (the transpose costs
     # ~20 ms at 2M x 28; assign_leaves needs the transposed layout)
+    work_layout: str = "rows",  # rows ((2, Npad, W) row-major) | planes
+    # ((2, W, Npad) feature-major: 128-lane tiles carry 128 rows of ONE
+    # byte column, and the root histogram folds into the pack pass)
 ) -> TreeLog:
     """Grow one leaf-wise tree with a physical row partition.
 
@@ -692,10 +695,13 @@ def build_tree_partitioned(
     Same in/out contract as ``build_tree``; runs identically single-device
     or under shard_map (all collectives go through ``comm``).
     """
-    from .ops.histogram import (hist16_segment, hist16_segment_q,
-                                hist_pallas_segment)
-    from .ops.partition import (pack_rows, pack_rows_quantized,
-                                partition_segment, partition_segment_fused)
+    from .ops.histogram import (hist16_segment, hist16_segment_planes,
+                                hist16_segment_q, hist_pallas_segment)
+    from .ops.partition import (pack_planes_fold_root, pack_rows,
+                                pack_rows_quantized, partition_segment,
+                                partition_segment_fused,
+                                partition_segment_planes,
+                                partition_segment_planes_fused, planes_npad)
 
     n, num_grp = bins.shape
     num_feat = int(meta.num_bins.shape[0])
@@ -703,42 +709,71 @@ def build_tree_partitioned(
     n_forced = 0 if forced is None else int(forced[0].shape[0])
     fused_part = part_kernel == "pallas"
     quantized = hist_mode == "int8"
+    planes = work_layout == "planes"
     from .ops.partition import work_spec
     guard, buf_width = work_spec(num_grp, quantized, part_kernel,
-                                 part_chunk, hist_chunk)
+                                 part_chunk, hist_chunk, layout=work_layout)
     bm = num_bin_hist if num_bin_hist is not None else num_bin
 
     # ---- packed ping-pong working buffers with guard rows ----
     # the matrix columns are EFB bundles (== features when no bundling)
-    pad = ((guard, guard), (0, 0))
-    if quantized:
-        # per-tree local quantization scales; histograms dequantize before
-        # any collective, so shards may scale independently
-        gscale = 127.0 / (jnp.max(jnp.abs(ghc[:, 0])) + 1e-12)
-        hscale = 127.0 / (jnp.max(jnp.abs(ghc[:, 1])) + 1e-12)
-        work0 = pack_rows_quantized(
-            jnp.pad(bins, pad), jnp.pad(ghc, pad),
-            jax.random.fold_in(key, 987123), gscale, hscale)
+    if planes:
+        if quantized:
+            raise ValueError("tpu_work_layout=planes does not support int8 "
+                             "quantized training (the learner gate keeps "
+                             "auto on rows for int8)")
+        # transposed (2, W, Npad) plane pair. The pack pass ALSO produces
+        # the root histogram — iteration 0 never re-reads the full matrix
+        # (stale bytes in a carried buffer's guard lanes are never consumed:
+        # partitions only commit valid rows and histograms mask by count)
+        if work_buf is not None:
+            work = work_buf
+        else:
+            work = jnp.zeros(
+                (2, buf_width, planes_npad(n, guard, part_kernel)),
+                jnp.uint8)
+        work, root_hist_loc = pack_planes_fold_root(
+            work, bins, ghc, guard, num_bins=bm,
+            exact=hist_mode != "bf16", chunk=hist_chunk, lo_w=hist_lo)
+        part_fn = partition_segment_planes_fused if fused_part \
+            else partition_segment_planes
     else:
-        work0 = pack_rows(jnp.pad(bins, pad), jnp.pad(ghc, pad))
-    if work_buf is not None:
-        # reuse the caller's ping-pong pair (fused blocks carry it across
-        # trees): only plane 0's used columns need writing — stale bytes
-        # elsewhere are never consumed (blends commit only valid rows, and
-        # the histogram/route reads touch only the used columns)
-        work = work_buf.at[0, :, :work0.shape[1]].set(work0)
-    else:
-        if work0.shape[1] < buf_width:
-            # the fused kernel DMAs whole 128-lane tiles; pad row width
-            work0 = jnp.pad(work0, ((0, 0), (0, buf_width - work0.shape[1])))
-        work = jnp.stack([work0, jnp.zeros_like(work0)])  # (2, Npad, W)
-    part_fn = partition_segment_fused if fused_part else partition_segment
+        pad = ((guard, guard), (0, 0))
+        if quantized:
+            # per-tree local quantization scales; histograms dequantize
+            # before any collective, so shards may scale independently
+            gscale = 127.0 / (jnp.max(jnp.abs(ghc[:, 0])) + 1e-12)
+            hscale = 127.0 / (jnp.max(jnp.abs(ghc[:, 1])) + 1e-12)
+            work0 = pack_rows_quantized(
+                jnp.pad(bins, pad), jnp.pad(ghc, pad),
+                jax.random.fold_in(key, 987123), gscale, hscale)
+        else:
+            work0 = pack_rows(jnp.pad(bins, pad), jnp.pad(ghc, pad))
+        if work_buf is not None:
+            # reuse the caller's ping-pong pair (fused blocks carry it
+            # across trees): only plane 0's used columns need writing —
+            # stale bytes elsewhere are never consumed (blends commit only
+            # valid rows, and the histogram/route reads touch only the
+            # used columns)
+            work = work_buf.at[0, :, :work0.shape[1]].set(work0)
+        else:
+            if work0.shape[1] < buf_width:
+                # the fused kernel DMAs whole 128-lane tiles; pad row width
+                work0 = jnp.pad(work0,
+                                ((0, 0), (0, buf_width - work0.shape[1])))
+            work = jnp.stack([work0, jnp.zeros_like(work0)])  # (2, Npad, W)
+        part_fn = partition_segment_fused if fused_part else partition_segment
 
     def hist_of(work, plane, start, cnt):
         """-> ((G, Bm, 3) reduced histogram, work). Callers must continue
         with the RETURNED work: the pallas kernel aliases the buffer
         through the call (identical bytes) so XLA never copies it."""
-        if quantized:
+        if planes:
+            h = hist16_segment_planes(work, plane, start, cnt, num_bins=bm,
+                                      num_feat=num_grp,
+                                      exact=hist_mode != "bf16",
+                                      chunk=hist_chunk, lo_w=hist_lo)
+        elif quantized:
             h = hist16_segment_q(work, plane, start, cnt, gscale, hscale,
                                  num_bins=bm, num_feat=num_grp,
                                  chunk=hist_chunk, lo_w=hist_lo)
@@ -859,8 +894,13 @@ def build_tree_partitioned(
     # ---- init: root ----
     root_sum_loc = jnp.sum(ghc, axis=0)
     root_sum = comm.root(root_sum_loc)
-    root_hist, work = hist_of(work, jnp.int32(0), jnp.int32(guard),
-                              jnp.int32(n))
+    if planes:
+        # folded into the pack pass above (bit-identical accumulation to
+        # hist_of over the root segment: same chunking, same einsum order)
+        root_hist = comm.hist(root_hist_loc)
+    else:
+        root_hist, work = hist_of(work, jnp.int32(0), jnp.int32(guard),
+                                  jnp.int32(n))
     # the pool is kept FLAT per leaf: 4-D pools make XLA's layout
     # assignment disagree between the while carry and the gather/update
     # consumers, inserting a full pool copy per split (measured 2x430 us at
@@ -1530,6 +1570,36 @@ class SerialTreeLearner:
                             "partition layout and a non-quantized mode; "
                             "using the XLA einsum")
                 hist_kernel = "xla"
+            if hist_kernel == "pallas" and hist_chunk % 32:
+                # the kernel re-derives DMA offsets as (x // 32) * 32; a
+                # misaligned chunk would double-count the rows between the
+                # aligned offset and the true chunk start — silently wrong
+                # histograms (ADVICE: refuse loudly, like part_chunk % 32)
+                Log.fatal("tpu_hist_chunk must be a multiple of 32 with "
+                          "the pallas histogram kernel (got %d)", hist_chunk)
+            layout = config.tpu_work_layout
+            if layout == "auto":
+                # planes pay off when a packed row wastes most of a
+                # 128-lane DMA tile; at > 256 B row-major tiles are already
+                # >= 2-tile efficient. int8 keeps rows (no quantized planes
+                # pack pass yet)
+                layout = "planes" if (
+                    jax.default_backend() in ("tpu", "axon")
+                    and row_w <= 256 and mode != "int8") else "rows"
+            elif layout == "planes" and mode == "int8":
+                Log.warning("tpu_work_layout=planes does not support int8 "
+                            "quantized training; using rows")
+                layout = "rows"
+            if layout == "planes" and hist_kernel == "pallas":
+                Log.warning("tpu_hist_kernel=pallas is row-major only; "
+                            "using the XLA planes einsum")
+                hist_kernel = "xla"
+            if layout == "planes" and part_kernel == "pallas" and (
+                    part_chunk % 128
+                    or (part_chunk > 256 and part_chunk % 256)):
+                Log.fatal("planes layout needs tpu_part_chunk a multiple "
+                          "of 128 and, above 256, of the 256-row "
+                          "compaction sub-block (got %d)", part_chunk)
             kw.update(
                 hist_chunk=hist_chunk,
                 part_chunk=part_chunk,
@@ -1539,6 +1609,7 @@ class SerialTreeLearner:
                 bundle=self.bundle,
                 part_kernel=part_kernel,
                 hist_kernel=hist_kernel,
+                work_layout=layout,
             )
         else:
             kw.update(
@@ -1618,12 +1689,16 @@ class SerialTreeLearner:
         of paying a fresh 2x(N,W) alloc+zero per tree)."""
         if not self.use_partition():
             return None
-        from .ops.partition import work_spec
+        from .ops.partition import planes_npad, work_spec
         kw = self.build_kwargs()
         guard, w = work_spec(self.bins.shape[1],
                              kw["hist_mode"] == "int8", kw["part_kernel"],
-                             kw["part_chunk"], kw["hist_chunk"])
+                             kw["part_chunk"], kw["hist_chunk"],
+                             layout=kw["work_layout"])
         n = self.bins.shape[0]
+        if kw["work_layout"] == "planes":
+            return ((2, w, planes_npad(n, guard, kw["part_kernel"])),
+                    jnp.uint8)
         return ((2, n + 2 * guard, w), jnp.uint8)
 
     def train(self, ghc: jax.Array, feature_mask: jax.Array, key: jax.Array,

@@ -32,6 +32,9 @@ import numpy as np
 try:  # pallas is optional at import time (CPU test meshes use XLA paths)
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
+    if not hasattr(pltpu, "HBM"):  # older jax spells these differently
+        pltpu.HBM = pltpu.ANY
+        pltpu.CompilerParams = pltpu.TPUCompilerParams
 except Exception:  # pragma: no cover
     pl = pltpu = None
 
@@ -391,6 +394,14 @@ def hist_pallas_segment(work: jax.Array, plane, start, cnt, *,
     width = work.shape[2]
     if width % 128:
         raise ValueError("hist_pallas_segment needs 128-lane work rows")
+    if chunk % 32:
+        # a misaligned chunk silently breaks the (x // 32) * 32 DMA offset
+        # re-derivation inside the kernel: rows between the aligned offset
+        # and the true chunk start would be double-counted. Refuse loudly;
+        # the learner gate (build_kwargs) surfaces this as a config error.
+        raise ValueError(
+            "hist_pallas_segment chunk must be a multiple of 32 "
+            "(u8 sublane DMA tiles), got %d" % chunk)
     kern = partial(_hist_pallas_kernel, ch=chunk, width=width, num_feat=f,
                    sh=sh, lo_w=lo_w, nch=nch)
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -452,6 +463,81 @@ def hist16_segment(work: jax.Array, plane, start, cnt, *,
         valid = jnp.arange(chunk, dtype=jnp.int32) < rows_left
         cgm = cg * valid[:, None].astype(jnp.float32)
         return acc + _hist16_chunk(cb, cgm, num_bins, exact, lo_w)
+
+    acc = jax.lax.fori_loop(
+        0, nchunks, body,
+        jnp.zeros((f, sh, lo_w * nch), jnp.float32))
+    return _hist16_combine(acc, num_bins, exact, lo_w)
+
+
+# ---------------------------------------------------------------------------
+# Planes (feature-major) layout
+# ---------------------------------------------------------------------------
+#
+# Transposed twin of the segment path above for the (2, W, Npad) work
+# buffer (ops/partition.py pack_planes): a chunk slice is (W, chunk) —
+# each one-hot build reads a CONTIGUOUS per-feature row instead of a
+# strided byte column, and rows sit on the 128-lane dim where the VPU
+# compares run at full occupancy. Bit-identity with the rows path is a
+# hard contract (tests/test_work_layout.py asserts identical trees): same
+# chunk boundaries, same lo*nch+ch x-ordering, and the per-chunk einsum
+# contracts over the same rows in the same f32 accumulation order — the
+# transposed einsum is verified bit-identical on the CPU backend.
+
+
+def _hist16_chunk_planes(cb, cgm, num_bins: int, exact: bool,
+                         lo_w: int = LO_W):
+    """(F, C) u8 bin planes + (3, C) f32 masked channel planes ->
+    (F, SH, lo_w*NCH) f32. Transposed twin of :func:`_hist16_chunk`."""
+    dt = _mxu_dtype()
+    sh = (num_bins + lo_w - 1) // lo_w
+    hi = (cb >> _LO_SHIFT[lo_w]).astype(jnp.uint8)
+    lo = (cb & (lo_w - 1)).astype(jnp.uint8)
+    hi_oh = (hi[:, None, :]
+             == jnp.arange(sh, dtype=jnp.uint8)[None, :, None]) \
+        .astype(dt)                                          # (F, SH, C)
+    lo_oh = (lo[:, None, :]
+             == jnp.arange(lo_w, dtype=jnp.uint8)[None, :, None])
+    if exact:
+        g_hi, g_lo = _split_bf16(cgm[0])
+        h_hi, h_lo = _split_bf16(cgm[1])
+        ch = jnp.stack([g_hi, g_lo, h_hi, h_lo,
+                        cgm[2].astype(jnp.bfloat16)], axis=0)  # (5, C)
+    else:
+        ch = cgm.astype(jnp.bfloat16)                        # (3, C)
+    nch = ch.shape[0]
+    f, c = cb.shape
+    log_ = (lo_oh[:, :, None, :].astype(dt)
+            * ch[None, None, :, :].astype(dt)).reshape(f, lo_w * nch, c)
+    return jnp.einsum("fhc,fxc->fhx", hi_oh, log_,
+                      preferred_element_type=jnp.float32)
+
+
+def hist16_segment_planes(work: jax.Array, plane, start, cnt, *,
+                          num_bins: int, num_feat: int, exact: bool = True,
+                          chunk: int = 2048, lo_w: int = 0) -> jax.Array:
+    """Planes-layout twin of :func:`hist16_segment` — same contract, work is
+    ``(2, W, Npad)`` u8 feature-major planes (ops/partition.py pack_planes):
+    bins planes followed by 12 (g, h, cnt) f32-byte planes."""
+    from .partition import unpack_ghc_planes
+
+    f = num_feat
+    lo_w = lo_w or auto_lo_w(f)
+    sh = (num_bins + lo_w - 1) // lo_w
+    nch = 5 if exact else 3
+    nchunks = (cnt + chunk - 1) // chunk
+    nplanes = work.shape[1]
+
+    def body(i, acc):
+        off = start + i * chunk
+        cw = jax.lax.dynamic_slice(work, (plane, 0, off),
+                                   (1, nplanes, chunk))[0]    # (W, CH)
+        cb = cw[:f]
+        cg = unpack_ghc_planes(cw, f)                         # (3, CH)
+        rows_left = cnt - i * chunk
+        valid = jnp.arange(chunk, dtype=jnp.int32) < rows_left
+        cgm = cg * valid[None, :].astype(jnp.float32)
+        return acc + _hist16_chunk_planes(cb, cgm, num_bins, exact, lo_w)
 
     acc = jax.lax.fori_loop(
         0, nchunks, body,

@@ -41,6 +41,7 @@ Buffers carry a CH-row guard region at BOTH ends (rows live in
 """
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import Tuple
 
@@ -50,8 +51,17 @@ import jax.numpy as jnp
 try:  # pallas is optional at import time (CPU test meshes use the XLA path)
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
+    if not hasattr(pltpu, "HBM"):  # pre-0.5 jax (CPU test meshes)
+        pltpu.HBM = pltpu.ANY
+        pltpu.CompilerParams = pltpu.TPUCompilerParams
 except Exception:  # pragma: no cover
     pl = pltpu = None
+
+# CPU-mesh validation hook: run the pallas kernels under the pallas
+# interpreter (tests/test_work_layout.py). Kernels that read the dst plane
+# through the ALIASED OUTPUT ref are bit-faithful under it (the interpreter
+# honors input_output_aliases and performs DMAs at .start()).
+_INTERPRET = os.environ.get("LGBTPU_PALLAS_INTERPRET", "") not in ("", "0")
 
 DEFAULT_CH = 2048
 GH_BYTES = 12   # g, h, cnt as f32 bytes
@@ -188,6 +198,160 @@ def partition_segment(
 
 
 # ---------------------------------------------------------------------------
+# Transposed (W, N) work-plane layout
+# ---------------------------------------------------------------------------
+#
+# The row-major buffer streams 128-lane rows of which only F+12 (~40) bytes
+# are real — a ~3x lane-occupancy waste on every partition DMA and VPU
+# convert (PERF.md wall-true attribution: partition is ~65% of the ~2.08
+# ms/split cost). The planes layout stores the SAME packed bytes transposed,
+#
+#     work[p]: (W, Npad) u8 — plane w holds byte column w of every row
+#
+# so each 128-lane tile carries 128 rows of ONE byte column: no dead lanes.
+# A segment is a contiguous LANE range; a split is still one dynamic slice
+# per chunk + one compaction matmul + two blended writes, just transposed —
+# and the compaction matmul contracts over W (~40) instead of the padded 128.
+# Row identity per chunk (dest computation) matches _compact_chunk exactly,
+# so the XLA planes path produces BIT-IDENTICAL trees to the rows path.
+
+
+def pack_planes(bins: jax.Array, ghc: jax.Array) -> jax.Array:
+    """(N, F) u8 + (N, 3) f32 -> (F+12, N) u8 plane-major working columns."""
+    return pack_rows(bins, ghc).T
+
+
+def unpack_ghc_planes(planes: jax.Array, num_feat: int) -> jax.Array:
+    """(F+12, C) u8 planes -> (3, C) f32 channels."""
+    gb = planes[num_feat:num_feat + GH_BYTES].reshape(3, 4, -1)
+    return jax.lax.bitcast_convert_type(gb.transpose(0, 2, 1), jnp.float32)
+
+
+def _compact_chunk_planes(cw, go, valid):
+    """Transposed twin of :func:`_compact_chunk`: cw is (W, CH) planes;
+    go/valid are (CH,) bool over the chunk's columns (rows of data).
+
+    dest is computed identically, so the produced row ORDER matches the
+    row-major path bit-for-bit (this is what makes trees bit-identical
+    across layouts: f32 histogram accumulation order is preserved)."""
+    ch = cw.shape[1]
+    gl = go & valid
+    gr = (~go) & valid
+    flags = jnp.stack([gl, gr, ~valid], axis=1).astype(jnp.int32)
+    ranks = jnp.cumsum(flags, axis=0) - flags
+    lrank, rrank, irank = ranks[:, 0], ranks[:, 1], ranks[:, 2]
+    nl = ranks[-1, 0] + flags[-1, 0]
+    nr = ranks[-1, 1] + flags[-1, 1]
+    dest = jnp.where(gl, lrank,
+                     jnp.where(gr, ch - nr + rrank, nl + irank))
+    # P[i, j] = (dest_i == j); compacted = planes @ P — the contraction runs
+    # over the CH source columns, costing W*CH MACs/column (W ~ 40 real
+    # bytes) instead of the rows path's 128-padded width
+    iota = jnp.arange(ch, dtype=jnp.int32)
+    perm = (dest[:, None] == iota[None, :]).astype(jnp.bfloat16)
+    cw2 = jax.lax.dot(cw.astype(jnp.bfloat16), perm,
+                      preferred_element_type=jnp.float32)
+    return cw2.astype(jnp.uint8), nl, nr
+
+
+def partition_segment_planes(
+    work: jax.Array,     # (2, W, Npad) u8 ping-pong plane pair
+    src_plane: jax.Array,
+    start: jax.Array,    # scalar i32 physical start LANE (includes guard)
+    cnt: jax.Array,
+    feat: jax.Array,
+    go_left: jax.Array,  # (B,) bool bin routing table
+    *,
+    ch: int = DEFAULT_CH,
+) -> Tuple[jax.Array, jax.Array]:
+    """Planes-layout :func:`partition_segment` (same contract, same row
+    order — left child stable, right child chunk-reversed — bit-identical
+    to the rows path)."""
+    num_bin = go_left.shape[0]
+    table = go_left.astype(jnp.float32)
+    nchunks = (cnt + ch - 1) // ch
+    w = work.shape[1]
+    dst_plane = 1 - src_plane
+
+    def body(i, carry):
+        work, lcur, rcur = carry
+        off = start + i * ch
+        cw = jax.lax.dynamic_slice(work, (src_plane, 0, off),
+                                   (1, w, ch))[0]           # (W, CH)
+        col = jax.lax.dynamic_index_in_dim(cw, feat, axis=0,
+                                           keepdims=False).astype(jnp.int32)
+        oh = (col[:, None] == jnp.arange(num_bin, dtype=jnp.int32)[None, :])
+        go = (oh.astype(jnp.float32) @ table) > 0.5
+        pos = off + jnp.arange(ch, dtype=jnp.int32)
+        valid = pos < start + cnt
+        cw2, nl, nr = _compact_chunk_planes(cw, go, valid)
+
+        j = jnp.arange(ch, dtype=jnp.int32)[None, :]
+
+        def blend_at(work, at, keep_left):
+            cur = jax.lax.dynamic_slice(work, (dst_plane, 0, at),
+                                        (1, w, ch))[0]
+            m = (j < nl) if keep_left else (j >= ch - nr)
+            return jax.lax.dynamic_update_slice(
+                work, jnp.where(m, cw2, cur)[None], (dst_plane, 0, at))
+
+        work = blend_at(work, lcur, True)
+        work = blend_at(work, rcur - ch, False)
+        return work, lcur + nl, rcur - nr
+
+    work, lcur, _ = jax.lax.fori_loop(
+        0, nchunks, body, (work, start, start + cnt))
+    return work, lcur - start
+
+
+def pack_planes_fold_root(work: jax.Array, bins: jax.Array, ghc: jax.Array,
+                          guard, *, num_bins: int, exact: bool, chunk: int,
+                          lo_w: int = 0):
+    """Planes pack pass with the root-node histogram FOLDED IN.
+
+    One chunked loop reads (bins, ghc) once, writes the transposed planes
+    into ``work[0][:, guard + i*chunk : ...]`` and accumulates the root
+    histogram from the SAME row-major chunk — iteration 0 never re-reads
+    the packed matrix. Chunk boundaries and masking replicate
+    hist16_segment(work, 0, guard, n) exactly, so the folded histogram is
+    bit-identical to the rows path's root pass.
+
+    Returns (work, (F, num_bins, 3) root histogram) — LOCAL, callers
+    reduce via comm.hist like any other segment histogram.
+    """
+    from .histogram import _hist16_chunk, _hist16_combine, auto_lo_w
+
+    n, f = bins.shape
+    lo_w = lo_w or auto_lo_w(f)
+    sh = (num_bins + lo_w - 1) // lo_w
+    nch = 5 if exact else 3
+    nchunks = (n + chunk - 1) // chunk
+    npc = nchunks * chunk
+    binsp = jnp.pad(bins, ((0, npc - n), (0, 0)))
+    ghcp = jnp.pad(ghc, ((0, npc - n), (0, 0)))
+
+    def body(i, carry):
+        work, acc = carry
+        off = i * chunk
+        cb = jax.lax.dynamic_slice(binsp, (off, 0), (chunk, f))
+        cg = jax.lax.dynamic_slice(ghcp, (off, 0), (chunk, 3))
+        valid = jnp.arange(chunk, dtype=jnp.int32) < n - off
+        cgm = cg * valid[:, None].astype(jnp.float32)
+        acc = acc + _hist16_chunk(cb, cgm, num_bins, exact, lo_w)
+        gb = jax.lax.bitcast_convert_type(cg, jnp.uint8) \
+            .reshape(chunk, GH_BYTES)
+        cw_t = jnp.concatenate([cb, gb], axis=1).T          # (W, chunk)
+        work = jax.lax.dynamic_update_slice(
+            work, cw_t[None], (0, 0, guard + off))
+        return work, acc
+
+    work, acc = jax.lax.fori_loop(
+        0, nchunks, body,
+        (work, jnp.zeros((f, sh, lo_w * nch), jnp.float32)))
+    return work, _hist16_combine(acc, num_bins, exact, lo_w)
+
+
+# ---------------------------------------------------------------------------
 # Fused Pallas kernel: the whole per-split pipeline in one device call
 # ---------------------------------------------------------------------------
 #
@@ -215,6 +379,7 @@ def partition_segment(
 
 
 ALIGN = 32  # Mosaic requires u8 DMA row offsets provably 32-aligned
+PLANE_ALIGN = 128  # planes layout: lane-dim DMA offsets are whole 128-lane tiles
 TABLE_WORDS = 8  # (B<=256,) bool routing table bit-packed into i32 scalars
 
 
@@ -232,20 +397,38 @@ def pack_table_bits(go_left: jax.Array) -> jax.Array:
 
 
 def work_spec(num_groups: int, quantized: bool, part_kernel: str,
-              part_chunk: int, hist_chunk: int):
-    """(guard_rows, row_width) of the packed ping-pong working buffer.
+              part_chunk: int, hist_chunk: int, layout: str = "rows"):
+    """(guard, width) of the packed ping-pong working buffer.
 
     Single source of truth shared by the tree builder and the fused
-    trainer's carried-buffer allocation: the fused pallas kernel needs
-    128-lane rows (whole-tile DMA) and guards that cover its aligned
-    write windows reaching up to ALIGN rows past a segment on each side.
+    trainer's carried-buffer allocation. Row-major layout: ``width`` is the
+    packed row width (the fused pallas kernel needs 128-lane rows and
+    guards covering aligned windows up to ALIGN rows past a segment).
+    Planes layout: ``width`` is the PLANE count (sublane dim of the
+    (2, W, Npad) buffer; the pallas kernel needs whole 32-sublane u8 tiles
+    and guards covering 128-lane-aligned windows — see planes_npad for the
+    lane-dim padding).
     """
     width = num_groups + (GH_BYTES_Q if quantized else GH_BYTES)
     guard = max(part_chunk, hist_chunk)
+    if layout == "planes":
+        if part_kernel == "pallas":
+            width = 32 * ((width + 31) // 32)  # whole u8 sublane tiles
+            guard += 2 * PLANE_ALIGN
+        return guard, width
     if part_kernel == "pallas":
         width = 128 * ((width + 127) // 128)   # whole 128-lane DMA tiles
         guard += 2 * ALIGN
     return guard, width
+
+
+def planes_npad(n: int, guard: int, part_kernel: str = "xla") -> int:
+    """Lane count of the planes work buffer: segment lanes + guards, padded
+    to whole 128-lane tiles when the pallas kernel DMAs it."""
+    npad = n + 2 * guard
+    if part_kernel == "pallas":
+        npad = 128 * ((npad + 127) // 128)
+    return npad
 
 
 def _partition_kernel(sref, work_in, work_ref, lt_ref,
@@ -616,5 +799,350 @@ def partition_segment_fused(
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",),
             vmem_limit_bytes=100 * 1024 * 1024),
+    )(scalars, work)
+    return work_out, lt[0]
+
+
+# ---------------------------------------------------------------------------
+# Fused Pallas kernel, planes layout
+# ---------------------------------------------------------------------------
+#
+# Transposed twin of _partition_kernel. All DYNAMIC offsets live on the
+# LANE dim (rows of data are lanes), where Mosaic's tiling is strictest —
+# so the kernel never slices VMEM dynamically on lanes at all:
+#
+# - HBM chunk reads use 128-lane-aligned windows derived as (x//128)*128
+#   at every use site (the lane twin of the rows kernel's (x//32)*32);
+# - in-chunk compaction runs per SB-column sub-block as ONE perm matmul
+#   (SB, LCAP) that does placement AND the circular wrap arithmetically:
+#   dest = (cursor + rank) mod LCAP, so frontier rows land at absolute
+#   circular stage slots and the stage update is a full-stage ADD — no
+#   dynamic window, no roll;
+# - the circular stages are (W, LCAP=2*SB) f32; a flush converts one
+#   STATIC half to u8 and pure-writes it to an aligned HBM window, then
+#   zeroes the half (future adds land on zeros);
+# - leftovers drain as up to 2 serial RMW tiles per side, left fully
+#   before right (their windows can overlap in the middle of the segment).
+#
+# dst-plane state (edge prefills, drain RMW reads) is read through
+# work_ref — the ALIASED OUTPUT — which is the same HBM buffer on device
+# and keeps the kernel bit-faithful under the pallas interpreter, so the
+# CPU suite validates it end-to-end (tests/test_work_layout.py). Per-row
+# cost vs the rows kernel at W=64: DMA bytes ~2x lower, VPU converts ~2-3x
+# lower, perm-matmul MACs comparable (2*W*LCAP vs 2*(SB+8)*128) — the
+# expected win is the DMA/VPU term (PERF.md layout row; on-TPU A/B via
+# scripts/layout_bisect.py).
+
+
+def _partition_planes_kernel(sref, work_in, work_ref, lt_ref,
+                             triu, cin, pre, lstage, rstage, lfb, rfb, sem,
+                             *, ch, sb, nplanes):
+    f32 = jnp.float32
+    lcap = 2 * sb
+    nsub = ch // sb
+    W = nplanes
+    src_plane = sref[0]
+    start = sref[1]
+    cnt = sref[2]
+    feat = sref[3]
+    dst_plane = 1 - src_plane
+
+    def a128(x):
+        # lane twin of the rows kernel's a32: re-derive every HBM lane
+        # offset as (x // 128) * 128 at the use site so Mosaic can PROVE
+        # whole-tile alignment
+        return (x // PLANE_ALIGN) * PLANE_ALIGN
+
+    lbase0 = (start // PLANE_ALIGN) * PLANE_ALIGN
+    head_l = start - lbase0                  # 0..127 neighbor lanes below
+    end = start + cnt
+    rtop = ((end - 1) // PLANE_ALIGN) * PLANE_ALIGN
+    rbase0 = rtop + PLANE_ALIGN
+    tail_r = rbase0 - end                    # 0..127 neighbor lanes above
+
+    tot = head_l + cnt
+    nchunks = (tot + ch - 1) // ch
+
+    # strict upper-triangular ones: ranks[j] = sum_{i<j} flags[i], flags
+    # along the LANE dim (flags (2, SB) @ triu (SB, SB) -> (2, SB))
+    row_i = jax.lax.broadcasted_iota(jnp.int32, (sb, sb), 0)
+    col_i = jax.lax.broadcasted_iota(jnp.int32, (sb, sb), 1)
+    triu[:] = jnp.clip(col_i - row_i, 0, 1).astype(f32).astype(jnp.bfloat16)
+
+    lane_c = jax.lax.broadcasted_iota(jnp.int32, (1, ch), 1)
+    sub_w = jax.lax.broadcasted_iota(jnp.int32, (W, 1), 0)
+    lane_128 = jax.lax.broadcasted_iota(jnp.int32, (W, PLANE_ALIGN), 1)
+    lane_sb_w = jax.lax.broadcasted_iota(jnp.int32, (W, sb), 1)
+
+    # ---- prefills: neighbor lanes of the aligned edge tiles ----
+    pl_in = pltpu.make_async_copy(
+        work_ref.at[dst_plane, :, pl.ds(lbase0, PLANE_ALIGN)],
+        pre.at[0], sem.at[2])
+    pl_in.start()
+    pr_in = pltpu.make_async_copy(
+        work_ref.at[dst_plane, :, pl.ds(rtop, PLANE_ALIGN)],
+        pre.at[1], sem.at[3])
+    pr_in.start()
+
+    def start_in(i, slot):
+        pltpu.make_async_copy(
+            work_in.at[src_plane, :, pl.ds(a128(lbase0 + i * ch), ch)],
+            cin.at[slot], sem.at[slot]).start()
+
+    start_in(0, 0)
+
+    # left stage: logical lane q (from lbase0, ascending) at slot q % LCAP.
+    # right stage: descending index q (from rbase0) at slot LCAP-1-(q%LCAP)
+    # — chosen so every flush half maps to its HBM window IN ORDER.
+    lstage[...] = jnp.zeros((W, lcap), f32)
+    rstage[...] = jnp.zeros((W, lcap), f32)
+    pl_in.wait()
+    lstage[:, 0:PLANE_ALIGN] = jnp.where(
+        lane_128 < head_l, pre[0].astype(jnp.int32).astype(f32), 0.0)
+    pr_in.wait()
+    rstage[:, lcap - PLANE_ALIGN:lcap] = jnp.where(
+        lane_128 >= PLANE_ALIGN - tail_r,
+        pre[1].astype(jnp.int32).astype(f32), 0.0)
+
+    def stage_half(stage, h):
+        """STATIC half selected by a traced bit (no dynamic lane slicing)."""
+        return jnp.where(h == 1, stage[:, sb:lcap], stage[:, 0:sb])
+
+    def flush(stage, fb, flushed, left, sem_base):
+        """Convert the completed SB-lane half, zero it, start its pure
+        aligned HBM write."""
+        nflush = flushed // sb
+        slot = jax.lax.rem(nflush, 2)
+
+        # slot reuse: wait the DMA issued 2 flushes ago (size-matched
+        # reconstruction; .wait() only consumes the semaphore)
+        @pl.when(nflush >= 2)
+        def _():
+            pltpu.make_async_copy(
+                fb.at[slot], work_ref.at[dst_plane, :, pl.ds(0, sb)],
+                sem.at[sem_base + slot]).wait()
+        h = slot if left else 1 - slot
+        lo_half = stage[:, 0:sb]
+        hi_half = stage[:, sb:lcap]
+        hb = h == 1
+        fb[slot] = jnp.where(hb, hi_half, lo_half) \
+            .astype(jnp.int32).astype(jnp.uint8)
+        stage[:, 0:sb] = jnp.where(hb, lo_half, 0.0)
+        stage[:, sb:lcap] = jnp.where(hb, 0.0, hi_half)
+        if left:
+            at = a128(lbase0 + flushed)
+        else:
+            at = a128(rbase0 - flushed) - sb
+        pltpu.make_async_copy(
+            fb.at[slot], work_ref.at[dst_plane, :, pl.ds(at, sb)],
+            sem.at[sem_base + slot]).start()
+
+    def body(i, carry):
+        p_l, p_r, fl_l, fl_r = carry
+        slot = jax.lax.rem(i, 2)
+        pltpu.make_async_copy(
+            work_in.at[src_plane, :, pl.ds(a128(lbase0 + i * ch), ch)],
+            cin.at[slot], sem.at[slot]).wait()
+
+        @pl.when(i + 1 < nchunks)
+        def _():
+            start_in(i + 1, 1 - slot)
+
+        cf = cin[slot].astype(jnp.int32).astype(f32)          # (W, CH)
+        # split column: one sublane reduction (feat is a traced sublane
+        # index — never a dynamic VMEM slice)
+        col = jnp.sum(jnp.where(sub_w == feat, cf, 0.0), axis=0,
+                      keepdims=True)                          # (1, CH)
+        coli = col.astype(jnp.int32)
+        word = jax.lax.shift_right_logical(coli, 5)
+        wvals = jnp.zeros((1, ch), jnp.int32)
+        for w_ in range(TABLE_WORDS):
+            wvals = jnp.where(word == w_, sref[4 + w_], wvals)
+        bit = jnp.bitwise_and(coli, 31)
+        go = jnp.bitwise_and(
+            jax.lax.shift_right_logical(wvals, bit), 1) > 0
+        pos = lane_c + i * ch
+        valid = (pos >= head_l) & (pos < tot)                 # (1, CH)
+
+        for s in range(nsub):
+            sub = cf[:, s * sb:(s + 1) * sb]                  # (W, SB)
+            gl = go[:, s * sb:(s + 1) * sb] & valid[:, s * sb:(s + 1) * sb]
+            gr = (~go[:, s * sb:(s + 1) * sb]) & valid[:, s * sb:(s + 1) * sb]
+            flags = jnp.concatenate(
+                [gl.astype(jnp.bfloat16), gr.astype(jnp.bfloat16)], axis=0)
+            ranks = jax.lax.dot(flags, triu[:],
+                                preferred_element_type=f32)   # (2, SB)
+            nl = jnp.sum(gl.astype(jnp.int32))
+            nr = jnp.sum(gr.astype(jnp.int32))
+            lrank = ranks[0:1, :].astype(jnp.int32)
+            rrank = ranks[1:2, :].astype(jnp.int32)
+            # absolute circular stage slots: the perm matmul does placement
+            # AND the wrap; unrouted columns get -1 (all-zero perm column)
+            dest_l = jnp.where(gl, jax.lax.rem(p_l + lrank, lcap), -1)
+            dest_r = jnp.where(gr, lcap - 1 - jax.lax.rem(p_r + rrank, lcap),
+                               -1)
+            j_i = jax.lax.broadcasted_iota(jnp.int32, (sb, lcap), 1)
+            perm_l = (1 - jnp.clip(jnp.abs(j_i - dest_l.reshape(sb, 1)),
+                                   0, 1)).astype(f32).astype(jnp.bfloat16)
+            perm_r = (1 - jnp.clip(jnp.abs(j_i - dest_r.reshape(sb, 1)),
+                                   0, 1)).astype(f32).astype(jnp.bfloat16)
+            # u8 payload bytes are integers <= 255: exact under a 0/1 bf16
+            # permutation matmul with f32 accumulation
+            sub_bf = sub.astype(jnp.bfloat16)
+            out_l = jax.lax.dot(sub_bf, perm_l, preferred_element_type=f32)
+            out_r = jax.lax.dot(sub_bf, perm_r, preferred_element_type=f32)
+            lstage[...] += out_l
+            rstage[...] += out_r
+            p_l = p_l + nl
+            p_r = p_r + nr
+
+            @pl.when(p_l - fl_l >= sb)
+            def _():
+                flush(lstage, lfb, fl_l, True, 4)
+            fl_l = jnp.where(p_l - fl_l >= sb, fl_l + sb, fl_l)
+
+            @pl.when(p_r - fl_r >= sb)
+            def _():
+                flush(rstage, rfb, fl_r, False, 6)
+            fl_r = jnp.where(p_r - fl_r >= sb, fl_r + sb, fl_r)
+
+        return p_l, p_r, fl_l, fl_r
+
+    p_l, p_r, fl_l, fl_r = jax.lax.fori_loop(
+        0, nchunks, body,
+        (head_l, tail_r, jnp.int32(0), jnp.int32(0)))
+
+    # ---- drain: wait ALL outstanding flushes first (their tiles can sit
+    # inside the other side's drain windows), then up to 2 serial RMW
+    # tiles per side, LEFT fully before RIGHT (windows may overlap where
+    # the frontiers meet) ----
+    for base, fb, fl in ((4, lfb, fl_l), (6, rfb, fl_r)):
+        nf = fl // sb
+        for back in (1, 2):
+            @pl.when(nf >= back)
+            def _(base=base, fb=fb, nf=nf, back=back):
+                pltpu.make_async_copy(
+                    fb.at[jax.lax.rem(nf - back, 2)],
+                    work_ref.at[dst_plane, :, pl.ds(0, sb)],
+                    sem.at[base + jax.lax.rem(nf - back, 2)]).wait()
+
+    for t in (0, 1):
+        @pl.when(t * sb < p_l - fl_l)
+        def _(t=t):
+            at = a128(lbase0 + fl_l) + t * sb
+            rd = pltpu.make_async_copy(
+                work_ref.at[dst_plane, :, pl.ds(at, sb)], lfb.at[0],
+                sem.at[4])
+            rd.start()
+            rd.wait()
+            h = jax.lax.rem(fl_l // sb + t, 2)
+            fresh = stage_half(lstage, h)
+            old = lfb[0].astype(jnp.int32).astype(f32)
+            qpos = fl_l + t * sb + lane_sb_w
+            merged = jnp.where(qpos < p_l, fresh, old)
+            lfb[0] = merged.astype(jnp.int32).astype(jnp.uint8)
+            wr = pltpu.make_async_copy(
+                lfb.at[0], work_ref.at[dst_plane, :, pl.ds(at, sb)],
+                sem.at[4])
+            wr.start()
+            wr.wait()
+
+    for t in (0, 1):
+        @pl.when(t * sb < p_r - fl_r)
+        def _(t=t):
+            at = a128(rbase0 - fl_r) - (t + 1) * sb
+            rd = pltpu.make_async_copy(
+                work_ref.at[dst_plane, :, pl.ds(at, sb)], rfb.at[0],
+                sem.at[6])
+            rd.start()
+            rd.wait()
+            h = 1 - jax.lax.rem(fl_r // sb + t, 2)
+            fresh = stage_half(rstage, h)
+            old = rfb[0].astype(jnp.int32).astype(f32)
+            # window lane c holds descending index q = fl_r+(t+1)*sb-1-c
+            keep = lane_sb_w >= (t + 1) * sb - (p_r - fl_r)
+            merged = jnp.where(keep, fresh, old)
+            rfb[0] = merged.astype(jnp.int32).astype(jnp.uint8)
+            wr = pltpu.make_async_copy(
+                rfb.at[0], work_ref.at[dst_plane, :, pl.ds(at, sb)],
+                sem.at[6])
+            wr.start()
+            wr.wait()
+
+    lt_ref[0] = p_l - head_l
+
+
+def partition_segment_planes_fused(
+    work: jax.Array,       # (2, W, Npad) u8 ping-pong plane pair
+    src_plane: jax.Array,
+    start: jax.Array,
+    cnt: jax.Array,
+    feat: jax.Array,
+    go_left: jax.Array,    # (B,) bool
+    *,
+    ch: int = DEFAULT_CH,
+    sb: int = 256,
+) -> Tuple[jax.Array, jax.Array]:
+    """Pallas form of :func:`partition_segment_planes` (same contract,
+    except row order WITHIN each side is unspecified — histograms are
+    order-free and sub-splits re-partition).
+
+    Requires whole-tile dims: Npad % 128 == 0 (lane DMA windows), plane
+    count a multiple of 32 (u8 sublane tiles), ch a multiple of 128 and of
+    the sub-block, and guards of at least ch + 2*PLANE_ALIGN lanes
+    (work_spec/planes_npad provide all four).
+    """
+    num_bin = go_left.shape[0]
+    _, nplanes, npad = work.shape
+    if npad % 128:
+        raise ValueError(
+            "fused planes partition needs whole 128-lane tiles in the lane "
+            "dim, got Npad=%d" % npad)
+    if nplanes % 32:
+        raise ValueError(
+            "fused planes partition needs whole 32-sublane u8 tiles, got "
+            "W=%d planes" % nplanes)
+    sb = min(sb, ch)
+    if ch % sb or ch % 128:
+        raise ValueError(
+            "planes partition chunk %d must be a multiple of 128 and of "
+            "the sub-block %d" % (ch, sb))
+    scalars = jnp.concatenate([
+        jnp.stack([src_plane.astype(jnp.int32), start.astype(jnp.int32),
+                   cnt.astype(jnp.int32), feat.astype(jnp.int32)]),
+        pack_table_bits(go_left)])
+
+    kern = partial(_partition_planes_kernel, ch=ch, sb=sb, nplanes=nplanes)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(1,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.HBM),
+        ],
+        out_specs=[
+            pl.BlockSpec(memory_space=pltpu.HBM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((sb, sb), jnp.bfloat16),              # triu
+            pltpu.VMEM((2, nplanes, ch), jnp.uint8),         # cin x2
+            pltpu.VMEM((2, nplanes, PLANE_ALIGN), jnp.uint8),  # prefills
+            pltpu.VMEM((nplanes, 2 * sb), jnp.float32),      # lstage
+            pltpu.VMEM((nplanes, 2 * sb), jnp.float32),      # rstage
+            pltpu.VMEM((2, nplanes, sb), jnp.uint8),         # lfb x2
+            pltpu.VMEM((2, nplanes, sb), jnp.uint8),         # rfb x2
+            pltpu.SemaphoreType.DMA((8,)),
+        ],
+    )
+    work_out, lt = pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct(work.shape, work.dtype),
+                   jax.ShapeDtypeStruct((1,), jnp.int32)],
+        input_output_aliases={1: 0},
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+            vmem_limit_bytes=100 * 1024 * 1024),
+        interpret=_INTERPRET,
     )(scalars, work)
     return work_out, lt[0]

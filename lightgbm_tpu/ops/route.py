@@ -27,6 +27,9 @@ import jax.numpy as jnp
 try:  # pallas is optional at import time (CPU meshes use the XLA path)
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
+    if not hasattr(pltpu, "HBM"):  # older jax spells these differently
+        pltpu.HBM = pltpu.ANY
+        pltpu.CompilerParams = pltpu.TPUCompilerParams
 except Exception:  # pragma: no cover
     pl = pltpu = None
 
