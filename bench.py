@@ -65,14 +65,19 @@ def make_mslr_like(n, f=137, docs_per_query=120, seed=11):
     return X.astype(np.float64), y, np.asarray(sizes, dtype=np.int64)
 
 
-def _phases(timer, wall):
+def _phases(timer, wall, traffic=None):
     """Fused-path phase dict for one timed train + its own accounting.
 
     dispatch = async block launches (host-side trace/launch work),
     logs_transfer = host blocked on the device + the split-log pull,
     host_trees = per-tree model reconstruction on host. logs_transfer is
     where device execution surfaces (the pipeline overlaps transfer of
-    block i with execution of block i+1, so it absorbs device time)."""
+    block i with execution of block i+1, so it absorbs device time).
+
+    traffic, when given, is the learner's deterministic bytes-per-row
+    accounting of the per-split hot loop (SerialTreeLearner.traffic_spec) —
+    merged AFTER the wall accounting so accounted_pct stays a pure
+    wall-time self-check."""
     t = timer.times
     keys = ("fused/block_fn", "fused/dispatch", "fused/logs_transfer",
             "fused/host_trees", "dataset construction")
@@ -80,7 +85,21 @@ def _phases(timer, wall):
     acc = sum(t.get(k, 0.0) for k in keys)
     out["other"] = round(max(wall - acc, 0.0), 3)
     out["accounted_pct"] = round(100.0 * min(acc / max(wall, 1e-9), 1.0), 1)
+    if traffic:
+        out["work_layout"] = traffic["work_layout"]
+        out["partition_bytes_per_row_split"] = \
+            traffic["partition_bytes_per_row"]
+        out["hist_gather_bytes_per_row"] = traffic["hist_bytes_per_row"]
     return out
+
+
+def _traffic(bst):
+    """traffic_spec of the trained Booster's learner, or None (dense
+    builder / unexpected internals — the bench must not fail on it)."""
+    try:
+        return bst.inner.learner.traffic_spec()
+    except Exception:
+        return None
 
 
 def run_higgs(lgb, n_rows, timer):
@@ -109,7 +128,7 @@ def run_higgs(lgb, n_rows, timer):
     t0 = time.time()
     bst = lgb.train(dict(params), ds, num_boost_round=N_ITER)
     train_s = time.time() - t0
-    phases = _phases(timer, train_s)
+    phases = _phases(timer, train_s, _traffic(bst))
     (_, _, auc, _), = bst.eval_train()
     return ((n_rows * N_ITER) / train_s, auc, train_s, warmup_s, t_gen,
             t_cons, phases)
@@ -140,7 +159,7 @@ def run_mslr(lgb, timer):
     t0 = time.time()
     bst = lgb.train(dict(params), ds, num_boost_round=RANK_ITER)
     train_s = time.time() - t0
-    phases = _phases(timer, train_s)
+    phases = _phases(timer, train_s, _traffic(bst))
     evals = {name: v for (_, name, v, _) in bst.eval_train()}
     ndcg = evals.get("ndcg@10", next(iter(evals.values())))
     return ((RANK_ROWS * RANK_ITER) / train_s, ndcg, train_s, warmup_s,

@@ -177,6 +177,24 @@ class BinnedDataset:
                                        jnp.asarray(self.binned))
         return self._device_bins_cache[2]
 
+    def device_resident_planes(self, guard: int, npad: int):
+        """Resident (F, npad) bin planes for tpu_resident_state, cached on
+        the dataset like :meth:`device_bins`: the planes live in ORIGINAL
+        row order and never change during training, so serial Boosters
+        reuse one device copy across trees instead of re-transposing the
+        matrix per call. Keyed on the host array's identity, the version
+        token AND the (guard, npad) geometry (part_chunk / part_kernel
+        changes move the guard band)."""
+        from .ops.partition import resident_bin_planes
+        ver = getattr(self, "_dev_version", 0)
+        cur = getattr(self, "_device_resident_cache", None)
+        if cur is None or cur[0] is not self.binned or cur[1] != ver \
+                or cur[2] != (guard, npad):
+            res = resident_bin_planes(self.device_bins(), guard, npad)
+            self._device_resident_cache = (self.binned, ver, (guard, npad),
+                                           res)
+        return self._device_resident_cache[3]
+
     @property
     def num_features(self) -> int:
         return len(self.bin_mappers)

@@ -285,9 +285,10 @@ class FusedTrainer:
         fmask_fn = make_feature_mask_fn(cfg, nf)
         build = learner.make_build_fn()
         wspec = learner.work_buf_spec()
+        rspec = learner.resident_spec()
 
-        def one_iter(sampler, bins, bins_t, meta, score, cegb_used, wbuf,
-                     key, it):
+        def one_iter(sampler, bins, bins_t, bins_res, meta, score, cegb_used,
+                     wbuf, key, it):
             if obj.needs_iter:
                 g, h = obj.get_gradients(score, it)
             else:
@@ -314,7 +315,8 @@ class FusedTrainer:
                     log, wbuf = build(
                         bins, ghc, meta, fmask,
                         jax.random.fold_in(key, it * 131 + c), cegb_used,
-                        work_buf=wbuf, return_work=True, bins_t=bins_t)
+                        work_buf=wbuf, return_work=True, bins_t=bins_t,
+                        bins_res=bins_res)
                 else:
                     log = build(bins, ghc, meta, fmask,
                                 jax.random.fold_in(key, it * 131 + c),
@@ -374,12 +376,20 @@ class FusedTrainer:
                                              ((0, 0), (0, npad - n_)))
                         bins_t = bins_t.reshape(bins.shape[1],
                                                 npad // 128, 128)
+                # resident bin planes for tpu_resident_state: uploaded once
+                # per block in ORIGINAL row order; the per-split partition
+                # only permutes the slim route/ridx/g/h/c payload and the
+                # histogram gathers bins through the row-index plane.
+                bins_res = None
+                if rspec is not None:
+                    from .ops.partition import resident_bin_planes
+                    bins_res = resident_bin_planes(bins, *rspec)
 
                 def body(carry, i):
                     score, used, wbuf = carry
                     score, used, wbuf, stacked = one_iter(
-                        sampler, bins, bins_t, meta, score, used, wbuf, key,
-                        it0 + i)
+                        sampler, bins, bins_t, bins_res, meta, score, used,
+                        wbuf, key, it0 + i)
                     return (score, used, wbuf), stacked
                 (score, used, _), stacked = jax.lax.scan(
                     body, (score, cegb_used, wbuf), jnp.arange(k))

@@ -678,7 +678,12 @@ def build_tree_partitioned(
     # ~20 ms at 2M x 28; assign_leaves needs the transposed layout)
     work_layout: str = "rows",  # rows ((2, Npad, W) row-major) | planes
     # ((2, W, Npad) feature-major: 128-lane tiles carry 128 rows of ONE
-    # byte column, and the root histogram folds into the pack pass)
+    # byte column, and the root histogram folds into the pack pass) |
+    # resident (planes family: bin planes live once in bins_res and the
+    # slim 17-plane work buffer moves only route/ridx/g/h/c per split)
+    bins_res: Optional[jax.Array] = None,  # (F, Npad) resident bin planes
+    # (work_layout=resident) — pass a block-hoisted copy when building
+    # many trees; derived in-graph from ``bins`` when None
 ) -> TreeLog:
     """Grow one leaf-wise tree with a physical row partition.
 
@@ -696,12 +701,16 @@ def build_tree_partitioned(
     or under shard_map (all collectives go through ``comm``).
     """
     from .ops.histogram import (hist16_segment, hist16_segment_planes,
-                                hist16_segment_q, hist_pallas_segment)
-    from .ops.partition import (pack_planes_fold_root, pack_rows,
+                                hist16_segment_q, hist16_segment_resident,
+                                hist_pallas_segment,
+                                hist_pallas_segment_planes)
+    from .ops.partition import (pack_planes_fold_root,
+                                pack_resident_fold_root, pack_rows,
                                 pack_rows_quantized, partition_segment,
                                 partition_segment_fused,
                                 partition_segment_planes,
-                                partition_segment_planes_fused, planes_npad)
+                                partition_segment_planes_fused, planes_npad,
+                                resident_bin_planes, write_route_plane)
 
     n, num_grp = bins.shape
     num_feat = int(meta.num_bins.shape[0])
@@ -709,7 +718,8 @@ def build_tree_partitioned(
     n_forced = 0 if forced is None else int(forced[0].shape[0])
     fused_part = part_kernel == "pallas"
     quantized = hist_mode == "int8"
-    planes = work_layout == "planes"
+    resident = work_layout == "resident"
+    planes = work_layout == "planes" or resident
     from .ops.partition import work_spec
     guard, buf_width = work_spec(num_grp, quantized, part_kernel,
                                  part_chunk, hist_chunk, layout=work_layout)
@@ -732,11 +742,34 @@ def build_tree_partitioned(
             work = jnp.zeros(
                 (2, buf_width, planes_npad(n, guard, part_kernel)),
                 jnp.uint8)
-        work, root_hist_loc = pack_planes_fold_root(
-            work, bins, ghc, guard, num_bins=bm,
-            exact=hist_mode != "bf16", chunk=hist_chunk, lo_w=hist_lo)
-        part_fn = partition_segment_planes_fused if fused_part \
+        base_part = partition_segment_planes_fused if fused_part \
             else partition_segment_planes
+        if resident:
+            # bin planes live ONCE (original row order, never partitioned);
+            # the slim work buffer carries route/ridx/g/h/c only
+            if bins_res is None:
+                bins_res = resident_bin_planes(bins, guard, work.shape[2])
+            work, root_hist_loc = pack_resident_fold_root(
+                work, bins, ghc, guard, num_bins=bm,
+                exact=hist_mode != "bf16", chunk=hist_chunk, lo_w=hist_lo)
+
+            def part_fn(work, plane, start, cnt, feat, table, *, ch):
+                # gather the split feature's resident bin bytes through the
+                # permuted row-index plane into the route plane, then
+                # stream the slim payload through the UNCHANGED planes
+                # partition (XLA or fused Mosaic) routing on plane 0 — the
+                # gathered column equals the planes path's leaf-order bin
+                # column value-for-value, so dest arithmetic (and trees)
+                # stay bit-identical
+                work = write_route_plane(work, bins_res, plane, start, cnt,
+                                         feat, ch=ch)
+                return base_part(work, plane, start, cnt, jnp.int32(0),
+                                 table, ch=ch)
+        else:
+            work, root_hist_loc = pack_planes_fold_root(
+                work, bins, ghc, guard, num_bins=bm,
+                exact=hist_mode != "bf16", chunk=hist_chunk, lo_w=hist_lo)
+            part_fn = base_part
     else:
         pad = ((guard, guard), (0, 0))
         if quantized:
@@ -768,7 +801,22 @@ def build_tree_partitioned(
         """-> ((G, Bm, 3) reduced histogram, work). Callers must continue
         with the RETURNED work: the pallas kernel aliases the buffer
         through the call (identical bytes) so XLA never copies it."""
-        if planes:
+        if resident:
+            # unit-stride gather over the resident bin planes through the
+            # permuted row-index plane; same chunking and f32 accumulation
+            # order as the planes path
+            h = hist16_segment_resident(work, bins_res, plane, start, cnt,
+                                        num_bins=bm, num_feat=num_grp,
+                                        exact=hist_mode != "bf16",
+                                        chunk=hist_chunk, lo_w=hist_lo)
+        elif planes and hist_kernel == "pallas":
+            h, work = hist_pallas_segment_planes(work, plane, start, cnt,
+                                                 num_bins=bm,
+                                                 num_feat=num_grp,
+                                                 exact=hist_mode != "bf16",
+                                                 chunk=hist_chunk,
+                                                 lo_w=hist_lo)
+        elif planes:
             h = hist16_segment_planes(work, plane, start, cnt, num_bins=bm,
                                       num_feat=num_grp,
                                       exact=hist_mode != "bf16",
@@ -927,14 +975,28 @@ def build_tree_partitioned(
         adv0 = _adv_boxes_init(num_leaves, num_feat, meta)
     else:
         adv0 = ()
+    if hp.mono_advanced:
+        node_best_pair = jax.vmap(
+            node_best, in_axes=(None, 0, 0, 0, 0, 0, 0, 0, None, None,
+                                None, 0))
+    else:
+        node_best_pair = jax.vmap(
+            node_best, in_axes=(None, 0, 0, 0, 0, 0, 0, 0, None, None, None))
+
+    # the root's initial search rides the SAME batched callable as the
+    # per-round two-child refresh (batch of 1): one traced split-scan chain
+    # serves every node_best call instead of compiling a second unbatched
+    # variant of the whole reduce-window/select pipeline
+    root_ix = jnp.array([0], jnp.int32)
     best = _empty_best(num_leaves, num_bin)
-    best = _set_best(best, 0,
-                     node_best(0, jnp.int32(0), root_hist, root_sum,
-                               root_sum_loc, leaf_out[0], leaf_lower[0],
-                               leaf_upper[0], leaf_used[0], tree_used0,
-                               jnp.int32(0),
-                               *((_adv_bounds_of(adv0, jnp.int32(0)),)
-                                 if hp.mono_advanced else ())))
+    root_info = node_best_pair(
+        0, root_ix, root_hist[None], root_sum[None], root_sum_loc[None],
+        leaf_out[:1], leaf_lower[:1], leaf_upper[:1], leaf_used[0],
+        tree_used0, jnp.int32(0),
+        *((jax.tree.map(lambda a: a[None],
+                        _adv_bounds_of(adv0, jnp.int32(0))),)
+          if hp.mono_advanced else ()))
+    best = jax.tree.map(lambda b, v: b.at[root_ix].set(v), best, root_info)
     log = TreeLog(
         num_splits=jnp.int32(0),
         split_leaf=jnp.zeros((max_splits,), jnp.int32),
@@ -957,14 +1019,6 @@ def build_tree_partitioned(
         if max_depth <= 0:
             return jnp.bool_(True)
         return depth < max_depth
-
-    if hp.mono_advanced:
-        node_best_pair = jax.vmap(
-            node_best, in_axes=(None, 0, 0, 0, 0, 0, 0, 0, None, None,
-                                None, 0))
-    else:
-        node_best_pair = jax.vmap(
-            node_best, in_axes=(None, 0, 0, 0, 0, 0, 0, 0, None, None, None))
 
     force_live = jnp.bool_(n_forced > 0)
     carry0 = (jnp.int32(0), work, leaf_start, leaf_cnt, leaf_parity,
@@ -1590,13 +1644,37 @@ class SerialTreeLearner:
                 Log.warning("tpu_work_layout=planes does not support int8 "
                             "quantized training; using rows")
                 layout = "rows"
-            if layout == "planes" and hist_kernel == "pallas":
-                Log.warning("tpu_hist_kernel=pallas is row-major only; "
-                            "using the XLA planes einsum")
+            rs = config.tpu_resident_state
+            if rs == "on":
+                if config.tpu_work_layout == "rows":
+                    Log.fatal("tpu_resident_state=on requires the planes "
+                              "work layout (got tpu_work_layout=rows)")
+                if mode == "int8":
+                    Log.fatal("tpu_resident_state=on does not support int8 "
+                              "quantized training (plane-family layouts "
+                              "are hilo/bf16 only)")
+                layout = "resident"
+            elif rs == "auto" and layout == "planes" \
+                    and jax.default_backend() in ("tpu", "axon"):
+                # resident state strictly reduces partition traffic where
+                # the planes layout already wins, and trees stay
+                # bit-identical; CPU meshes keep plain planes (the gather
+                # has no payoff without HBM bandwidth pressure)
+                layout = "resident"
+            if layout == "resident" and hist_kernel == "pallas":
+                Log.warning("tpu_hist_kernel=pallas has no resident gather "
+                            "path; using the XLA gather einsum")
                 hist_kernel = "xla"
-            if layout == "planes" and part_kernel == "pallas" and (
-                    part_chunk % 128
-                    or (part_chunk > 256 and part_chunk % 256)):
+            if layout == "planes" and hist_kernel == "pallas" \
+                    and hist_chunk % 128:
+                # the planes kernel re-derives lane DMA offsets as
+                # (x // 128) * 128 — a misaligned chunk double-counts rows
+                Log.fatal("tpu_hist_chunk must be a multiple of 128 with "
+                          "the planes pallas histogram kernel (got %d)",
+                          hist_chunk)
+            if layout in ("planes", "resident") and part_kernel == "pallas" \
+                    and (part_chunk % 128
+                         or (part_chunk > 256 and part_chunk % 256)):
                 Log.fatal("planes layout needs tpu_part_chunk a multiple "
                           "of 128 and, above 256, of the 256-row "
                           "compaction sub-block (got %d)", part_chunk)
@@ -1696,16 +1774,72 @@ class SerialTreeLearner:
                              kw["part_chunk"], kw["hist_chunk"],
                              layout=kw["work_layout"])
         n = self.bins.shape[0]
-        if kw["work_layout"] == "planes":
+        if kw["work_layout"] in ("planes", "resident"):
             return ((2, w, planes_npad(n, guard, kw["part_kernel"])),
                     jnp.uint8)
         return ((2, n + 2 * guard, w), jnp.uint8)
+
+    def resident_spec(self):
+        """(guard, npad) of the resident bin-plane buffer, or None when the
+        resolved layout is not resident. Shared by the fused trainer's
+        per-block hoist and the dataset's version-token device cache."""
+        if not self.use_partition():
+            return None
+        from .ops.partition import planes_npad, work_spec
+        kw = self.build_kwargs()
+        if kw["work_layout"] != "resident":
+            return None
+        guard, _ = work_spec(self.bins.shape[1],
+                             kw["hist_mode"] == "int8", kw["part_kernel"],
+                             kw["part_chunk"], kw["hist_chunk"],
+                             layout=kw["work_layout"])
+        return guard, planes_npad(self.bins.shape[0], guard,
+                                  kw["part_kernel"])
+
+    def traffic_spec(self):
+        """Deterministic bytes-moved accounting of the per-split hot loop
+        for the resolved config (bench observability; PERF.md traffic
+        tables). Per PARENT ROW per split: the partition reads the src
+        chunk and writes the dst chunk at the moved work width (plus the
+        resident route pre-pass: 4 ridx read + 1 gather read + 1 route
+        write); the smaller-child histogram reads the payload planes plus,
+        for resident, the F gathered bin bytes."""
+        if not self.use_partition():
+            return None
+        from .ops.partition import RST_GH_OFF, work_spec
+        kw = self.build_kwargs()
+        layout = kw["work_layout"]
+        _, w = work_spec(self.bins.shape[1], kw["hist_mode"] == "int8",
+                         kw["part_kernel"], kw["part_chunk"],
+                         kw["hist_chunk"], layout=layout)
+        f = self.bins.shape[1]
+        part = 2 * w
+        if layout == "resident":
+            part += RST_GH_OFF + 1      # route pre-pass gather traffic
+            hist = w + f                # slim payload + gathered bin bytes
+        elif layout == "planes":
+            hist = w
+        else:
+            hist = w                    # row-major reads the packed row
+        return {"work_layout": layout, "work_width": int(w),
+                "partition_bytes_per_row": int(part),
+                "hist_bytes_per_row": int(hist)}
 
     def train(self, ghc: jax.Array, feature_mask: jax.Array, key: jax.Array,
               cegb_used: Optional[jax.Array] = None) -> TreeLog:
         """One tree from (grad, hess, inbag) channels. Returns the device log."""
         if cegb_used is None:
             cegb_used = jnp.zeros((self.dataset.num_features,), bool)
+        rspec = getattr(self, "_rspec_cache", False)
+        if rspec is False:
+            rspec = self._rspec_cache = self.resident_spec()
+        if rspec is not None:
+            # one cached device copy of the resident bin planes per dataset
+            # (original row order, training-invariant) instead of an
+            # in-graph transpose per tree
+            return self._build(
+                self.bins, ghc, self.meta, feature_mask, key, cegb_used,
+                bins_res=self.dataset.device_resident_planes(*rspec))
         return self._build(self.bins, ghc, self.meta, feature_mask, key,
                            cegb_used)
 

@@ -543,3 +543,204 @@ def hist16_segment_planes(work: jax.Array, plane, start, cnt, *,
         0, nchunks, body,
         jnp.zeros((f, sh, lo_w * nch), jnp.float32))
     return _hist16_combine(acc, num_bins, exact, lo_w)
+
+
+def hist16_segment_resident(work: jax.Array, resident: jax.Array, plane,
+                            start, cnt, *, num_bins: int, num_feat: int,
+                            exact: bool = True, chunk: int = 2048,
+                            lo_w: int = 0) -> jax.Array:
+    """Resident-state twin of :func:`hist16_segment_planes`.
+
+    ``work`` is the slim (2, W>=17, Npad) buffer (route | ridx x4 | g/h/c
+    x12 planes); ``resident`` is the (F, Npad) bin-plane buffer in ORIGINAL
+    row order. Per chunk the permuted row-index plane is decoded and the
+    bin planes are gathered through it — a unit-stride take along the lane
+    axis — reproducing the planes path's leaf-order bin bytes value-for-
+    value. Chunk grid, valid masking and _hist16_chunk_planes accumulation
+    order are identical, so histograms (and the trees built from them) stay
+    bit-identical to ``tpu_work_layout=planes``.
+    """
+    from .partition import (RST_GH_OFF, RST_ROUTE, RST_WIDTH, _decode_ridx,
+                            unpack_ghc_planes)
+
+    f = num_feat
+    lo_w = lo_w or auto_lo_w(f)
+    sh = (num_bins + lo_w - 1) // lo_w
+    nch = 5 if exact else 3
+    nchunks = (cnt + chunk - 1) // chunk
+    npad = work.shape[2]
+
+    def body(i, acc):
+        off = start + i * chunk
+        cw = jax.lax.dynamic_slice(work, (plane, 0, off),
+                                   (1, RST_WIDTH, chunk))[0]
+        ridx = _decode_ridx(cw[RST_ROUTE:RST_GH_OFF], npad)
+        cb = jnp.take(resident, ridx, axis=1)                 # (F, CH)
+        cg = unpack_ghc_planes(cw, RST_GH_OFF)                # (3, CH)
+        rows_left = cnt - i * chunk
+        valid = jnp.arange(chunk, dtype=jnp.int32) < rows_left
+        cgm = cg * valid[None, :].astype(jnp.float32)
+        return acc + _hist16_chunk_planes(cb, cgm, num_bins, exact, lo_w)
+
+    acc = jax.lax.fori_loop(
+        0, nchunks, body,
+        jnp.zeros((f, sh, lo_w * nch), jnp.float32))
+    return _hist16_combine(acc, num_bins, exact, lo_w)
+
+
+def _hist_pallas_kernel_planes(sref, work_in, work_ref, acc_ref, cin, acc_s,
+                               sem, *, ch, nplanes, num_feat, sh, lo_w, nch,
+                               dt):
+    # Plane-major port of _hist_pallas_kernel: a chunk DMA is a contiguous
+    # (W, ch) lane slice — bins arrive as whole per-feature sublane rows
+    # (no strided byte columns) and f32 words re-assemble from 4 byte
+    # PLANES instead of 4 byte columns. Same aliasing contract: work_ref is
+    # never written, it only keeps the donated buffer from being copied.
+    f32 = jnp.float32
+    i32 = jnp.int32
+    plane = sref[0]
+    start = sref[1]
+    cnt = sref[2]
+    F = num_feat
+
+    astart = (start // 128) * 128
+    head = start - astart
+    tot = head + cnt
+    nchunks = jnp.maximum((tot + ch - 1) // ch, 1)
+
+    acc_s[...] = jnp.zeros((F * sh, lo_w * nch), f32)
+
+    def start_in(i, slot):
+        # (x // 128) * 128 at the USE SITE proves the u8 lane-dim DMA
+        # offset is whole 128-lane tiles (the planes twin of the rows
+        # kernel's 32-row sublane alignment)
+        at = ((astart + i * ch) // 128) * 128
+        pltpu.make_async_copy(
+            work_in.at[plane, :, pl.ds(at, ch)],
+            cin.at[slot], sem.at[slot]).start()
+
+    start_in(0, 0)
+
+    lane_i = jax.lax.broadcasted_iota(i32, (1, ch), 1)
+    iota_sh = jax.lax.broadcasted_iota(i32, (sh, ch), 0)
+    jl = jax.lax.broadcasted_iota(i32, (lo_w * nch, ch), 0) // nch
+
+    def word(gb, o):
+        # f32 plane from its 4 u8 byte planes; multiplies, not shifts
+        # (vector << by >= 16 miscompiles on this toolchain — see the rows
+        # kernel). i32 overflow of the top byte wraps to the sign bits.
+        return jax.lax.bitcast_convert_type(
+            gb[o:o + 1] + gb[o + 1:o + 2] * 256
+            + gb[o + 2:o + 3] * 65536
+            + gb[o + 3:o + 4] * 16777216, f32)
+
+    def body(i, carry):
+        slot = jax.lax.rem(i, 2)
+        at = ((astart + i * ch) // 128) * 128
+        pltpu.make_async_copy(
+            work_in.at[plane, :, pl.ds(at, ch)],
+            cin.at[slot], sem.at[slot]).wait()
+
+        @pl.when(i + 1 < nchunks)
+        def _():
+            start_in(i + 1, 1 - slot)
+
+        cw = cin[slot].astype(i32)                      # (W, CH)
+        bi = cw[:F]
+        hi = bi // lo_w
+        lo = bi - hi * lo_w
+        gb = cw[F:F + 12]
+        pos = lane_i + i * ch
+        valid = ((pos >= head) & (pos < tot)).astype(f32)
+        g = word(gb, 0) * valid
+        h = word(gb, 4) * valid
+        c = word(gb, 8) * valid
+        if nch == 5:
+            g_hi = g.astype(jnp.bfloat16)
+            g_lo = (g - g_hi.astype(f32)).astype(jnp.bfloat16)
+            h_hi = h.astype(jnp.bfloat16)
+            h_lo = (h - h_hi.astype(f32)).astype(jnp.bfloat16)
+            chs = jnp.concatenate(
+                [g_hi, g_lo, h_hi, h_lo, c.astype(jnp.bfloat16)], axis=0)
+        else:
+            chs = jnp.concatenate([g, h, c], axis=0).astype(jnp.bfloat16)
+        tiled = jnp.concatenate([chs] * lo_w, axis=0).astype(dt)
+
+        for f in range(F):
+            hioh = (hi[f:f + 1] == iota_sh).astype(dt)  # (SH, CH)
+            logf = jnp.where(lo[f:f + 1] == jl, tiled,
+                             jnp.zeros((), dt))         # (lo_w*nch, CH)
+            ps = jax.lax.dot_general(
+                hioh, logf, (((1,), (1,)), ((), ())),
+                preferred_element_type=f32)             # (SH, lo_w*nch)
+            acc_s[f * sh:(f + 1) * sh, :] += ps
+        return carry
+
+    jax.lax.fori_loop(0, nchunks, body, 0)
+    out_cp = pltpu.make_async_copy(acc_s, acc_ref, sem.at[0])
+    out_cp.start()
+    out_cp.wait()
+
+
+def hist_pallas_segment_planes(work: jax.Array, plane, start, cnt, *,
+                               num_bins: int, num_feat: int,
+                               exact: bool = True, chunk: int = 4096,
+                               lo_w: int = 0):
+    """Pallas twin of :func:`hist16_segment_planes` for the (2, W, Npad)
+    plane-major work buffer. Requires the planes pallas work layout: W a
+    multiple of 32 sublanes, lane starts 128-aligned +/- head, chunk a
+    multiple of 128.
+
+    Returns ``(hist, work)`` — same aliasing contract as
+    :func:`hist_pallas_segment`. Runs under the pallas interpreter off-TPU
+    (LGBTPU_PALLAS_INTERPRET=1) with f32 operands so the parity test can
+    compare against the exact XLA path.
+    """
+    from .partition import _INTERPRET
+
+    f = num_feat
+    lo_w = lo_w or auto_lo_w(f)
+    sh = (num_bins + lo_w - 1) // lo_w
+    nch = 5 if exact else 3
+    nplanes = work.shape[1]
+    if nplanes % 32:
+        raise ValueError(
+            "hist_pallas_segment_planes needs whole 32-sublane u8 plane "
+            "tiles, got W=%d" % nplanes)
+    if chunk % 128:
+        # a misaligned chunk breaks the (x // 128) * 128 lane-offset
+        # re-derivation inside the kernel (lanes between the aligned offset
+        # and the true chunk start would be double-counted)
+        raise ValueError(
+            "hist_pallas_segment_planes chunk must be a multiple of 128 "
+            "(lane DMA tiles), got %d" % chunk)
+    kern = partial(_hist_pallas_kernel_planes, ch=chunk, nplanes=nplanes,
+                   num_feat=f, sh=sh, lo_w=lo_w, nch=nch, dt=_mxu_dtype())
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(1,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.HBM)],
+        out_specs=[pl.BlockSpec(memory_space=pltpu.HBM),
+                   pl.BlockSpec(memory_space=pltpu.HBM)],
+        scratch_shapes=[
+            pltpu.VMEM((2, nplanes, chunk), jnp.uint8),
+            pltpu.VMEM((f * sh, lo_w * nch), jnp.float32),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    scalars = jnp.stack([plane.astype(jnp.int32), start.astype(jnp.int32),
+                         cnt.astype(jnp.int32)])
+    work_out, acc = pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct(work.shape, work.dtype),
+                   jax.ShapeDtypeStruct((f * sh, lo_w * nch), jnp.float32)],
+        input_output_aliases={1: 0},
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+            vmem_limit_bytes=100 * 1024 * 1024),
+        interpret=_INTERPRET,
+    )(scalars, work)
+    h = _hist16_combine(acc.reshape(f, sh, lo_w * nch), num_bins, exact,
+                        lo_w)
+    return h, work_out

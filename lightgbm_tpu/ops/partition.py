@@ -67,6 +67,14 @@ DEFAULT_CH = 2048
 GH_BYTES = 12   # g, h, cnt as f32 bytes
 GH_BYTES_Q = 3  # quantized: g, h as int8 bits, cnt as u8
 
+# Resident-state slim work buffer (tpu_resident_state): the bin planes stay
+# put in ORIGINAL row order and the partition permutes only a route byte, an
+# i32 row-index plane (4 byte-planes) and the g/h/c payload.
+RST_ROUTE = 1                        # plane 0: split feature's bin byte
+RST_RIDX = 4                         # planes 1..4: row index, LE byte planes
+RST_GH_OFF = RST_ROUTE + RST_RIDX    # planes 5..16: g/h/c f32 bytes
+RST_WIDTH = RST_GH_OFF + GH_BYTES
+
 
 def guard_rows(ch: int = DEFAULT_CH) -> int:
     return ch
@@ -352,6 +360,122 @@ def pack_planes_fold_root(work: jax.Array, bins: jax.Array, ghc: jax.Array,
 
 
 # ---------------------------------------------------------------------------
+# Resident permuted state: partition a row-index plane, not the packed row
+# ---------------------------------------------------------------------------
+#
+# The planes partition rewrites every plane of the work buffer per split —
+# bins AND g/h/c. With tpu_resident_state the bin planes live ONCE in a
+# (F, Npad) resident buffer in original row order, and the slim work buffer
+# carries only [route | ridx x4 | g/h/c x12] = 17 planes. Before each
+# partition a chunked gather pass writes the split feature's resident bin
+# byte into the route plane (write_route_plane); partition_segment_planes
+# and partition_segment_planes_fused then run UNCHANGED with feat=0,
+# inheriting the Mosaic path (circular f32 stages, 128-aligned pure-write
+# flushes, scalar-prefetched routing table) and — because the gathered
+# route byte equals the leaf-order bin column value-for-value — the exact
+# _compact_chunk_planes dest arithmetic, so trees stay bit-identical.
+# Segment histograms gather the bin planes through the permuted row-index
+# plane (hist16_segment_resident) with the planes path's chunking and f32
+# accumulation order.
+
+
+def resident_bin_planes(bins: jax.Array, guard, npad: int) -> jax.Array:
+    """(N, F) u8 grouped bins -> (F, npad) u8 resident planes, original row
+    i at lane guard + i. Written once per dataset; never re-partitioned."""
+    res = jnp.zeros((bins.shape[1], npad), jnp.uint8)
+    return jax.lax.dynamic_update_slice(res, bins.T, (0, guard))
+
+
+def _decode_ridx(planes: jax.Array, npad: int) -> jax.Array:
+    """(4, C) u8 LE byte-planes -> (C,) i32 row indices, clamped to the
+    buffer. Lanes outside the live segment hold stale dst-parity bytes that
+    can decode to anything (including negative i32); the clamp keeps the
+    gather in bounds — every consumer valid-masks those lanes anyway."""
+    b = planes.astype(jnp.int32)
+    ridx = b[0] + b[1] * 256 + b[2] * 65536 + b[3] * 16777216
+    return jnp.clip(ridx, 0, npad - 1)
+
+
+def _encode_ridx(pos: jax.Array) -> jax.Array:
+    """(C,) i32 -> (4, C) u8 little-endian byte planes."""
+    sh = jnp.arange(RST_RIDX, dtype=jnp.int32)[:, None] * 8
+    return ((pos[None, :] >> sh) & 255).astype(jnp.uint8)
+
+
+def write_route_plane(work: jax.Array, resident: jax.Array, plane, start,
+                      cnt, feat, *, ch: int = DEFAULT_CH) -> jax.Array:
+    """Write the split feature's bin byte for each segment row into the
+    route plane (plane 0) of the slim work buffer's ``plane`` parity.
+
+    Decodes the permuted row-index planes on the SAME chunk grid the
+    partition uses and gathers the feature's resident bin plane — the
+    result is value-for-value the routing column the planes layout reads
+    from its leaf-order work buffer, so the planes partition runs unchanged
+    with feat=0. O(parent): ~6 bytes/row (4 ridx read + 1 gather read +
+    1 route write) against the planes path's full-width read.
+    """
+    npad = work.shape[2]
+    col = jax.lax.dynamic_index_in_dim(resident, feat, axis=0, keepdims=False)
+    nchunks = (cnt + ch - 1) // ch
+
+    def body(i, work):
+        off = start + i * ch
+        rb = jax.lax.dynamic_slice(work, (plane, RST_ROUTE, off),
+                                   (1, RST_RIDX, ch))[0]
+        route = jnp.take(col, _decode_ridx(rb, npad), axis=0)
+        return jax.lax.dynamic_update_slice(
+            work, route[None, None, :], (plane, 0, off))
+
+    return jax.lax.fori_loop(0, nchunks, body, work)
+
+
+def pack_resident_fold_root(work: jax.Array, bins: jax.Array, ghc: jax.Array,
+                            guard, *, num_bins: int, exact: bool, chunk: int,
+                            lo_w: int = 0):
+    """Resident-state pack pass with the root histogram folded in.
+
+    Mirrors :func:`pack_planes_fold_root` chunk-for-chunk (same
+    _hist16_chunk accumulation order -> bit-identical root histogram) but
+    writes the SLIM planes: a zeroed route plane, row-index byte planes
+    holding ABSOLUTE lane positions (guard offset included, so gathers need
+    no offset arithmetic), and the g/h/c bytes. The bin planes live in the
+    resident buffer and are never packed.
+    """
+    from .histogram import _hist16_chunk, _hist16_combine, auto_lo_w
+
+    n, f = bins.shape
+    lo_w = lo_w or auto_lo_w(f)
+    sh = (num_bins + lo_w - 1) // lo_w
+    nch = 5 if exact else 3
+    nchunks = (n + chunk - 1) // chunk
+    npc = nchunks * chunk
+    binsp = jnp.pad(bins, ((0, npc - n), (0, 0)))
+    ghcp = jnp.pad(ghc, ((0, npc - n), (0, 0)))
+
+    def body(i, carry):
+        work, acc = carry
+        off = i * chunk
+        cb = jax.lax.dynamic_slice(binsp, (off, 0), (chunk, f))
+        cg = jax.lax.dynamic_slice(ghcp, (off, 0), (chunk, 3))
+        valid = jnp.arange(chunk, dtype=jnp.int32) < n - off
+        cgm = cg * valid[:, None].astype(jnp.float32)
+        acc = acc + _hist16_chunk(cb, cgm, num_bins, exact, lo_w)
+        pos = guard + off + jnp.arange(chunk, dtype=jnp.int32)
+        gb = jax.lax.bitcast_convert_type(cg, jnp.uint8) \
+            .reshape(chunk, GH_BYTES)
+        cw_t = jnp.concatenate([jnp.zeros((RST_ROUTE, chunk), jnp.uint8),
+                                _encode_ridx(pos), gb.T], axis=0)
+        work = jax.lax.dynamic_update_slice(
+            work, cw_t[None], (0, 0, guard + off))
+        return work, acc
+
+    work, acc = jax.lax.fori_loop(
+        0, nchunks, body,
+        (work, jnp.zeros((f, sh, lo_w * nch), jnp.float32)))
+    return work, _hist16_combine(acc, num_bins, exact, lo_w)
+
+
+# ---------------------------------------------------------------------------
 # Fused Pallas kernel: the whole per-split pipeline in one device call
 # ---------------------------------------------------------------------------
 #
@@ -411,7 +535,9 @@ def work_spec(num_groups: int, quantized: bool, part_kernel: str,
     """
     width = num_groups + (GH_BYTES_Q if quantized else GH_BYTES)
     guard = max(part_chunk, hist_chunk)
-    if layout == "planes":
+    if layout in ("planes", "resident"):
+        if layout == "resident":
+            width = RST_WIDTH    # slim payload; bin planes live elsewhere
         if part_kernel == "pallas":
             width = 32 * ((width + 31) // 32)  # whole u8 sublane tiles
             guard += 2 * PLANE_ALIGN

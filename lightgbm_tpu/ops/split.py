@@ -218,18 +218,21 @@ def find_best_split(
             & (hl >= hp.min_sum_hessian_in_leaf) & (hr >= hp.min_sum_hessian_in_leaf)
         return jnp.where(ok, gain - parent_gain, NEG_INF)
 
-    # threshold t means bins <= t go left; missing assigned per direction
-    gain_dr = eval_dir(cum)                                  # missing -> right
-    gain_dl = eval_dir(cum + miss[:, None, :])               # missing -> left
-    # nothing to gain from dl when there is no missing mass; keep dr on ties
-    gain_dl = jnp.where(meta.movable_missing[:, None], gain_dl, NEG_INF)
+    # threshold t means bins <= t go left; missing assigned per direction.
+    # Both directions ride ONE stacked (2, F, B) eval — _split_gain_pair
+    # broadcasts over leading axes, so this halves the per-round op chain
+    # the 254-round scan dispatches (split-scan diet).
     t_valid = (b_iota[None, :] < meta.num_bins[:, None] - 1) & ~meta.is_categorical[:, None]
     if rand_threshold is not None:
         # extra-trees: only one random threshold per feature is considered
         # (reference: USE_RAND_SPLIT in FindBestThresholdSequentially)
         t_valid = t_valid & (b_iota[None, :] == rand_threshold[:, None])
-    gain_dr = jnp.where(t_valid, gain_dr, NEG_INF)
-    gain_dl = jnp.where(t_valid, gain_dl, NEG_INF)
+    gains2 = eval_dir(jnp.stack([cum, cum + miss[:, None, :]], axis=0))
+    # nothing to gain from dl when there is no missing mass; keep dr on ties
+    gains2 = jnp.where(
+        jnp.stack([t_valid, t_valid & meta.movable_missing[:, None]], axis=0),
+        gains2, NEG_INF)
+    gain_dr, gain_dl = gains2[0], gains2[1]
     num_gain = jnp.maximum(gain_dr, gain_dl)                 # (F, B)
     num_dl = gain_dl > gain_dr
 
@@ -264,9 +267,12 @@ def find_best_split(
         order_desc = jnp.argsort(-key_desc, axis=1)
         n_groups = jnp.sum(group_ok, axis=1)                         # (F,)
 
-        def mvm_gains(order):
-            h_sorted = jnp.take_along_axis(hist, order[:, :, None], axis=1)
-            csum = jnp.cumsum(h_sorted, axis=1)                      # prefix of k+1
+        def mvm_gains(order2):
+            # both sort directions in ONE stacked (2, F, B) eval, same
+            # collapse as the numerical missing-direction pair above
+            h_sorted = jnp.take_along_axis(hist[None], order2[..., None],
+                                           axis=2)
+            csum = jnp.cumsum(h_sorted, axis=2)                      # prefix of k+1
             k1 = b_iota[None, :] + 1.0                               # prefix size
             left = csum
             right = total - left
@@ -280,8 +286,8 @@ def find_best_split(
                 & (right[..., 1] >= hp.min_sum_hessian_in_leaf)
             return jnp.where(ok, gain - parent_gain, NEG_INF)
 
-        mvm_asc = mvm_gains(order_asc)
-        mvm_desc = mvm_gains(order_desc)
+        mvm_asc, mvm_desc = mvm_gains(jnp.stack([order_asc, order_desc],
+                                                axis=0))
         num_gain = jnp.where(meta.is_categorical[:, None], NEG_INF, num_gain)
     else:
         oh_gain = jnp.full_like(num_gain, NEG_INF)
@@ -291,8 +297,14 @@ def find_best_split(
         num_gain = jnp.where(meta.is_categorical[:, None], NEG_INF, num_gain)
 
     # ---------- combine ----------
+    # One live-lane mask and ONE final select instead of a chain of
+    # per-adjustment wheres over the full (4, F, B) plane: every adjustment
+    # runs unguarded on the adjusted values (keeping the reference op order
+    # gain*penalty, *mono_pen, -cegb — bit-identical on live lanes) and
+    # dead lanes are forced to -inf once at the end.
     stacked = jnp.stack([num_gain, oh_gain, mvm_asc, mvm_desc], axis=0)  # (4, F, B)
-    stacked = stacked * jnp.where(stacked > NEG_INF, meta.penalty[None, :, None], 1.0)
+    live = (stacked > NEG_INF) & feature_mask[None, :, None]
+    adj = stacked * meta.penalty[None, :, None]
     if hp.has_monotone and hp.monotone_penalty > 0 and node_depth is not None:
         # reference: monotone_constraints.hpp:355 — splits on monotone
         # features at shallow depths are discounted (and forbidden while
@@ -304,12 +316,10 @@ def find_best_split(
                         jnp.where(p <= 1.0, 1.0 - p / (2.0 ** d) + eps,
                                   1.0 - 2.0 ** (p - 1.0 - d) + eps))
         mono_f = meta.monotone != 0
-        stacked = jnp.where(mono_f[None, :, None] & (stacked > NEG_INF),
-                            stacked * pen, stacked)
+        adj = jnp.where(mono_f[None, :, None], adj * pen, adj)
     if hp.use_cegb and cegb_delta is not None:
-        stacked = jnp.where(stacked > NEG_INF,
-                            stacked - cegb_delta[None, :, None], stacked)
-    stacked = jnp.where(feature_mask[None, :, None], stacked, NEG_INF)
+        adj = adj - cegb_delta[None, :, None]
+    stacked = jnp.where(live, adj, NEG_INF)
     if want_feature_gains:
         return jnp.max(stacked, axis=(0, 2))                 # (F,)
     flat = stacked.reshape(-1)

@@ -44,6 +44,18 @@ from ..utils.log import Log
 DATA_AXIS = "data"
 
 
+def _shard_map(fn, *, mesh, in_specs, out_specs):
+    # jax >= 0.6 exposes shard_map at top level (check_vma); older releases
+    # only have the experimental module (check_rep). Replication checking is
+    # off either way: the learners do their own collectives through Comm.
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map
+    return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs, check_rep=False)
+
+
 def make_mesh(n_devices: Optional[int] = None) -> Mesh:
     devs = jax.devices()
     if n_devices is not None:
@@ -118,11 +130,10 @@ class _MeshTreeLearner(SerialTreeLearner):
                       "(max_bin <= 256)", self.comm_mode)
         inner = self.make_build_fn()
         data_spec = P(DATA_AXIS) if self.rows_sharded else P()
-        sharded = jax.shard_map(
+        sharded = _shard_map(
             inner, mesh=mesh,
             in_specs=(data_spec, data_spec, P(), P(), P(), P()),
             out_specs=_tree_log_specs(row_spec),
-            check_vma=False,
         )
         self._build = jax.jit(sharded)
 
