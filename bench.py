@@ -19,7 +19,6 @@ secondary fields and a phase breakdown of this script's own wall}.
 import json
 import os
 import sys
-import time
 
 import numpy as np
 
@@ -103,9 +102,10 @@ def _traffic(bst):
 
 
 def run_higgs(lgb, n_rows, timer):
-    t0 = time.time()
-    X, y = make_higgs_like(n_rows)
-    t_gen = time.time() - t0
+    from lightgbm_tpu import obs
+    with obs.wall("higgs/datagen") as w:
+        X, y = make_higgs_like(n_rows)
+    t_gen = w.seconds
     params = {
         "objective": "binary",
         "num_leaves": NUM_LEAVES,
@@ -115,19 +115,22 @@ def run_higgs(lgb, n_rows, timer):
         "metric": ["auc"],
         "tpu_iter_block": 20,
     }
-    t0 = time.time()
-    ds = lgb.Dataset(X, label=y)
-    ds.construct()
-    t_cons = time.time() - t0
+    with obs.wall("higgs/construct") as w:
+        ds = lgb.Dataset(X, label=y)
+        ds.construct()
+    t_cons = w.seconds
     # short warmup train populates the persistent compile cache (reference
-    # timings likewise exclude one-time setup)
-    t0 = time.time()
-    lgb.train(dict(params), ds, num_boost_round=20)
-    warmup_s = time.time() - t0
+    # timings likewise exclude one-time setup); every train wall ends in a
+    # forced 1-element transfer of the score (PERF.md discipline via obs)
+    with obs.wall("higgs/warmup") as w:
+        wb = lgb.train(dict(params), ds, num_boost_round=20)
+        obs.sync(wb.inner.train_score.score)
+    warmup_s = w.seconds
     timer.reset()
-    t0 = time.time()
-    bst = lgb.train(dict(params), ds, num_boost_round=N_ITER)
-    train_s = time.time() - t0
+    with obs.wall("higgs/train") as w:
+        bst = lgb.train(dict(params), ds, num_boost_round=N_ITER)
+        obs.sync(bst.inner.train_score.score)
+    train_s = w.seconds
     phases = _phases(timer, train_s, _traffic(bst))
     (_, _, auc, _), = bst.eval_train()
     return ((n_rows * N_ITER) / train_s, auc, train_s, warmup_s, t_gen,
@@ -135,9 +138,10 @@ def run_higgs(lgb, n_rows, timer):
 
 
 def run_mslr(lgb, timer):
-    t0 = time.time()
-    X, y, group = make_mslr_like(RANK_ROWS)
-    t_gen = time.time() - t0
+    from lightgbm_tpu import obs
+    with obs.wall("mslr/datagen") as w:
+        X, y, group = make_mslr_like(RANK_ROWS)
+    t_gen = w.seconds
     params = {
         "objective": "lambdarank",
         "num_leaves": NUM_LEAVES,
@@ -148,17 +152,19 @@ def run_mslr(lgb, timer):
         "eval_at": [10],
         "tpu_iter_block": 10,
     }
-    t0 = time.time()
-    ds = lgb.Dataset(X, label=y, group=group)
-    ds.construct()
-    t_cons = time.time() - t0
-    t0 = time.time()
-    lgb.train(dict(params), ds, num_boost_round=10)
-    warmup_s = time.time() - t0
+    with obs.wall("mslr/construct") as w:
+        ds = lgb.Dataset(X, label=y, group=group)
+        ds.construct()
+    t_cons = w.seconds
+    with obs.wall("mslr/warmup") as w:
+        wb = lgb.train(dict(params), ds, num_boost_round=10)
+        obs.sync(wb.inner.train_score.score)
+    warmup_s = w.seconds
     timer.reset()
-    t0 = time.time()
-    bst = lgb.train(dict(params), ds, num_boost_round=RANK_ITER)
-    train_s = time.time() - t0
+    with obs.wall("mslr/train") as w:
+        bst = lgb.train(dict(params), ds, num_boost_round=RANK_ITER)
+        obs.sync(bst.inner.train_score.score)
+    train_s = w.seconds
     phases = _phases(timer, train_s, _traffic(bst))
     evals = {name: v for (_, name, v, _) in bst.eval_train()}
     ndcg = evals.get("ndcg@10", next(iter(evals.values())))
@@ -212,6 +218,9 @@ def main():
             result["rank_train_breakdown"] = r_ph
         except Exception as e:  # pragma: no cover - report, don't fail
             result["rank_error"] = "%s: %s" % (type(e).__name__, str(e)[:200])
+    # full structured-counter view of the run (dataset cache traffic, fused
+    # dispatch/flush, per-tree growth, auto-knob resolutions, bench walls)
+    result["telemetry"] = lgb.obs.telemetry.snapshot()
     print(json.dumps(result))
 
 

@@ -17,6 +17,7 @@ __version__ = "0.1.0"
 
 from .config import Config
 from .utils.log import Log, LightGBMError
+from . import obs
 
 try:  # full API surface; modules come online as the build proceeds
     from .basic import Booster, Dataset, register_logger
@@ -46,6 +47,7 @@ except ImportError:  # pragma: no cover
 
 __all__ = [
     "Config",
+    "obs",
     "Log",
     "LightGBMError",
     "Dataset",

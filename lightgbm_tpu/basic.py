@@ -278,6 +278,14 @@ class Booster:
     def num_model_per_iteration(self) -> int:
         return self.inner.num_tree_per_iteration
 
+    def telemetry(self) -> Dict[str, Any]:
+        """JSON-serializable snapshot of the process-global telemetry
+        registry (phase timers, dataset device-cache hit/miss counts,
+        fused-pipeline dispatch/flush counters, per-tree growth stats and
+        ``auto`` knob resolutions). See :mod:`lightgbm_tpu.obs`."""
+        from .obs import telemetry
+        return telemetry.snapshot()
+
     def eval_train(self, feval=None):
         return self.inner.eval_train(feval)
 

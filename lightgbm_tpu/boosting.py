@@ -33,10 +33,12 @@ from functools import partial as _partial
 def _score_add(score, lv, leaf_assign, scale, class_id):
     """One fused launch per tree contribution (kept jitted: the eager form
     retraced per op and dominated DART/rollback wall-clock)."""
-    vals = leaf_values_by_row(lv, leaf_assign, lv.shape[0]) * scale
-    if score.ndim > 1:
-        return score.at[:, class_id].add(vals)
-    return score + vals
+    from .obs import trace_phase
+    with trace_phase("lgbtpu/score_update"):
+        vals = leaf_values_by_row(lv, leaf_assign, lv.shape[0]) * scale
+        if score.ndim > 1:
+            return score.at[:, class_id].add(vals)
+        return score + vals
 
 
 class ScoreTracker:
@@ -259,7 +261,7 @@ class GBDT:
         # while a fused block is in flight, score already includes it but
         # models/iter_ lag; entry points that read or extend them must
         # finalize first so external callers never observe divergent state
-        self.finish_fused()
+        self.finish_fused("train_one_iter")
         it = self.iter_
         if grad is None:
             g, h = self._grad_fn(self.train_score.score, jnp.int32(it))
@@ -282,6 +284,28 @@ class GBDT:
             tree = self._finalize_tree(log, k)
             self.models.append(tree)
             self._note_used_features(tree)
+            # eager-path growth counters (fused blocks count in _count_trees)
+            from .obs import telemetry
+            splits = tree.num_leaves - 1
+            telemetry.count("tree/trees")
+            telemetry.count("tree/splits", splits)
+            telemetry.count("tree/leaves", tree.num_leaves)
+            # launch accounting: one partition pass + one smaller-child
+            # histogram per split; rows layout adds a root histogram per
+            # tree (planes/resident fold the root into the pack)
+            try:
+                spec = self.learner.traffic_spec()
+            except Exception:
+                spec = None
+            root_hists = 0 if (spec and spec["work_layout"] != "rows") else 1
+            telemetry.count("learner/partition_launches", splits)
+            telemetry.count("learner/hist_launches", splits + root_hists)
+            if spec:
+                telemetry.gauge("traffic/work_layout", spec["work_layout"])
+                telemetry.gauge("traffic/partition_bytes_per_row",
+                                spec["partition_bytes_per_row"])
+                telemetry.gauge("traffic/hist_bytes_per_row",
+                                spec["hist_bytes_per_row"])
             if tree.num_leaves > 1:
                 any_nonconstant = True
         self.iter_ += 1
@@ -454,15 +478,17 @@ class GBDT:
             self._fused = FusedTrainer(self)
         return self._fused.run(k)
 
-    def finish_fused(self) -> bool:
-        """Finalize any in-flight fused block (host trees + cegb state)."""
+    def finish_fused(self, reason: str = "unspecified") -> bool:
+        """Finalize any in-flight fused block (host trees + cegb state).
+        ``reason`` names the calling read API for the
+        ``fused/flush/<reason>`` telemetry counters."""
         if getattr(self, "_fused", None) is None:
             return False
-        return self._fused.flush()
+        return self._fused.flush(reason)
 
     def rollback_one_iter(self) -> None:
         """(reference: gbdt.cpp:454 RollbackOneIter)"""
-        self.finish_fused()
+        self.finish_fused("rollback_one_iter")
         if self.iter_ <= 0:
             return
         for _ in range(self.num_tree_per_iteration):
@@ -606,7 +632,7 @@ class GBDT:
     def predict(self, X: np.ndarray, *, raw_score: bool = False,
                 start_iteration: int = 0, num_iteration: int = -1,
                 pred_leaf: bool = False) -> np.ndarray:
-        self.finish_fused()
+        self.finish_fused("predict")
         X = np.asarray(X, dtype=np.float64)
         n = X.shape[0]
         K = self.num_tree_per_iteration
@@ -630,7 +656,7 @@ class GBDT:
     # --------------------------------------------------------------- model IO
     def model_to_string(self, num_iteration: int = -1) -> str:
         """(reference: gbdt_model_text.cpp:400 SaveModelToString)"""
-        self.finish_fused()
+        self.finish_fused("model_to_string")
         cfg = self.config
         K = self.num_tree_per_iteration
         total_iters = len(self.models) // max(K, 1)
@@ -685,7 +711,7 @@ class GBDT:
         a PredictRaw accumulator (init scores included) and extern-C
         single-row entry points so the file both drops into user code and
         compiles into a test harness."""
-        self.finish_fused()
+        self.finish_fused("to_if_else_cpp")
         K = self.num_tree_per_iteration
         total_iters = len(self.models) // max(K, 1)
         if num_iteration is None or num_iteration <= 0:
@@ -808,7 +834,7 @@ class GBDT:
         return model
 
     def dump_json(self, num_iteration: int = -1) -> str:
-        self.finish_fused()
+        self.finish_fused("dump_json")
         K = self.num_tree_per_iteration
         total_iters = len(self.models) // max(K, 1)
         if num_iteration is None or num_iteration <= 0:
@@ -827,17 +853,17 @@ class GBDT:
 
     @property
     def current_iteration(self) -> int:
-        self.finish_fused()
+        self.finish_fused("current_iteration")
         return self.iter_
 
     def num_trees(self) -> int:
-        self.finish_fused()
+        self.finish_fused("num_trees")
         return len(self.models)
 
     def feature_importance(self, importance_type: str = "split",
                            iteration: int = -1) -> np.ndarray:
         """(reference: GBDT::FeatureImportance, gbdt.cpp)"""
-        self.finish_fused()
+        self.finish_fused("feature_importance")
         nf = self.train_set.num_total_features if self.train_set else (
             max((t.split_feature.max() for t in self.models
                  if t.num_leaves > 1), default=-1) + 1)

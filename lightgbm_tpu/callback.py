@@ -8,10 +8,13 @@ from typing import Any, Callable, Dict, List, Union
 
 from .utils.log import Log
 
+# ``telemetry`` (the process-global obs.Telemetry registry) defaults to None
+# so positional six-field constructions keep working.
 CallbackEnv = collections.namedtuple(
     "CallbackEnv",
     ["model", "params", "iteration", "begin_iteration", "end_iteration",
-     "evaluation_result_list"])
+     "evaluation_result_list", "telemetry"],
+    defaults=(None,))
 
 
 class EarlyStopException(Exception):

@@ -23,14 +23,30 @@ from .utils.log import Log, verbosity_to_level
 
 def parse_args(argv: List[str]) -> Dict[str, Any]:
     """``config=file`` + ``key=value`` overrides
-    (reference: application.cpp:52-85 — config file first, CLI wins)."""
+    (reference: application.cpp:52-85 — config file first, CLI wins).
+    One flag-style extra on top of the reference grammar:
+    ``--dump-telemetry PATH`` (or ``--dump-telemetry=PATH``) maps to the
+    ``dump_telemetry`` parameter."""
     cli: Dict[str, str] = {}
-    for a in argv:
+    argv = list(argv)
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a == "--dump-telemetry" and i + 1 < len(argv):
+            cli["dump_telemetry"] = argv[i + 1].strip()
+            i += 2
+            continue
+        if a.startswith("--dump-telemetry="):
+            cli["dump_telemetry"] = a.split("=", 1)[1].strip()
+            i += 1
+            continue
         if "=" not in a:
             Log.warning("Unknown argument: %s", a)
+            i += 1
             continue
         k, v = a.split("=", 1)
         cli[k.strip()] = v.strip()
+        i += 1
     params: Dict[str, Any] = {}
     if "config" in cli or "config_file" in cli:
         params.update(load_config_file(cli.get("config") or cli["config_file"]))
@@ -157,7 +173,14 @@ def main(argv: Optional[List[str]] = None) -> None:
     if not argv:
         print(__doc__)
         return
-    Application(parse_args(argv)).run()
+    app = Application(parse_args(argv))
+    app.run()
+    if app.config.dump_telemetry:
+        import json
+        from .obs import telemetry
+        with open(app.config.dump_telemetry, "w") as f:
+            json.dump(telemetry.snapshot(), f, indent=2)
+        Log.info("Dumped telemetry to %s", app.config.dump_telemetry)
 
 
 if __name__ == "__main__":

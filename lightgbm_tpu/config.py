@@ -130,6 +130,9 @@ class Config:
     convert_model_language: str = "cpp"   # cpp | json
     saved_feature_importance_type: int = 0
     snapshot_freq: int = -1
+    # write the obs.Telemetry snapshot (JSON) here after the CLI task
+    # finishes; empty = no dump (also settable as --dump-telemetry PATH)
+    dump_telemetry: str = ""
 
     # ---- linear tree ----
     linear_tree: bool = False

@@ -97,6 +97,8 @@ class Metadata:
         (``meta.label = new``) invalidates by identity and does not need
         this."""
         self._dev_version = getattr(self, "_dev_version", 0) + 1
+        from .obs import telemetry
+        telemetry.count("dataset/bump_version")
 
     def _dev_cached(self, name):
         # Keyed on (array identity, version token). Identity catches
@@ -106,6 +108,7 @@ class Metadata:
         # otherwise treated as immutable once a Booster holds the dataset
         # (the reference's set_label/set_weight APIs reassign).
         import jax.numpy as jnp
+        from .obs import telemetry
         arr = getattr(self, name)
         if arr is None:
             return None
@@ -113,7 +116,12 @@ class Metadata:
         key = "_device_" + name + "_cache"
         cur = getattr(self, key, None)
         if cur is None or cur[0] is not arr or cur[1] != ver:
+            telemetry.count("dataset/device_%s/miss" % name)
+            telemetry.count("dataset/device_%s/upload_bytes" % name,
+                            int(getattr(arr, "nbytes", 0)))
             setattr(self, key, (arr, ver, jnp.asarray(arr, jnp.float32)))
+        else:
+            telemetry.count("dataset/device_%s/hit" % name)
         return getattr(self, key)[2]
 
 
@@ -161,6 +169,8 @@ class BinnedDataset:
         identity and does not need this; ``binned`` is otherwise immutable
         once construction finishes."""
         self._dev_version = getattr(self, "_dev_version", 0) + 1
+        from .obs import telemetry
+        telemetry.count("dataset/bump_version")
 
     def device_bins(self):
         """Device copy of the binned matrix, cached on the dataset: the
@@ -170,11 +180,17 @@ class BinnedDataset:
         (:meth:`bump_version`) — identity alone cannot see in-place writes
         into the same ndarray."""
         import jax.numpy as jnp
+        from .obs import telemetry
         ver = getattr(self, "_dev_version", 0)
         cur = getattr(self, "_device_bins_cache", None)
         if cur is None or cur[0] is not self.binned or cur[1] != ver:
+            telemetry.count("dataset/device_bins/miss")
+            telemetry.count("dataset/device_bins/upload_bytes",
+                            int(self.binned.nbytes))
             self._device_bins_cache = (self.binned, ver,
                                        jnp.asarray(self.binned))
+        else:
+            telemetry.count("dataset/device_bins/hit")
         return self._device_bins_cache[2]
 
     def device_resident_planes(self, guard: int, npad: int):
@@ -185,14 +201,20 @@ class BinnedDataset:
         matrix per call. Keyed on the host array's identity, the version
         token AND the (guard, npad) geometry (part_chunk / part_kernel
         changes move the guard band)."""
+        from .obs import telemetry
         from .ops.partition import resident_bin_planes
         ver = getattr(self, "_dev_version", 0)
         cur = getattr(self, "_device_resident_cache", None)
         if cur is None or cur[0] is not self.binned or cur[1] != ver \
                 or cur[2] != (guard, npad):
+            # no upload bytes counted: the planes derive ON DEVICE from the
+            # (already counted) device_bins copy
+            telemetry.count("dataset/resident_planes/miss")
             res = resident_bin_planes(self.device_bins(), guard, npad)
             self._device_resident_cache = (self.binned, ver, (guard, npad),
                                            res)
+        else:
+            telemetry.count("dataset/resident_planes/hit")
         return self._device_resident_cache[3]
 
     @property

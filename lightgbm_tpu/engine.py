@@ -13,6 +13,7 @@ import numpy as np
 from .basic import Booster, Dataset
 from .callback import CallbackEnv, EarlyStopException, early_stopping, log_evaluation
 from .config import resolve_aliases
+from .obs import telemetry
 from .utils.log import Log, LightGBMError
 
 
@@ -106,14 +107,14 @@ def train(
         except BaseException:
             # best-effort cleanup; never mask the primary error
             try:
-                booster.inner.finish_fused()
+                booster.inner.finish_fused("train_error")
             except BaseException:
                 pass
             raise
         else:
             # the fused path pipelines host tree reconstruction one block
             # behind the device; finalize the in-flight block
-            stopped = booster.inner.finish_fused() or stopped
+            stopped = booster.inner.finish_fused("train_end") or stopped
         if stopped:
             Log.warning("Stopped training because there are no more leaves "
                         "that meet the split requirements")
@@ -126,7 +127,8 @@ def train(
 
     for it in range(begin, begin + num_boost_round):
         for cb in callbacks_before:
-            cb(CallbackEnv(booster, params, it, begin, begin + num_boost_round, None))
+            cb(CallbackEnv(booster, params, it, begin,
+                           begin + num_boost_round, None, telemetry))
         with global_timer.timed("boosting iteration"):
             stop = booster.update(fobj=fobj)
         # periodic model snapshots for resume (reference: gbdt.cpp:277
@@ -141,7 +143,7 @@ def train(
         try:
             for cb in callbacks_after:
                 cb(CallbackEnv(booster, params, it, begin,
-                               begin + num_boost_round, evals))
+                               begin + num_boost_round, evals, telemetry))
         except EarlyStopException as e:
             booster.best_iteration = e.best_iteration + 1
             for name, metric, value, _ in e.best_score or []:

@@ -26,6 +26,7 @@ import numpy as np
 
 from .config import Config
 from .learner import SerialTreeLearner, TreeLog, leaf_values_by_row
+from .obs import telemetry, trace_phase
 from .utils.timer import global_timer
 
 # Process-wide cache of jitted block functions. A Booster's jitted callables
@@ -324,13 +325,15 @@ class FusedTrainer:
                 valid_r = jnp.arange(log.feature.shape[0]) < log.num_splits
                 cegb_used = cegb_used.at[
                     jnp.where(valid_r, log.feature, nf)].set(True, mode="drop")
-                vals = log.leaf_value * jnp.float32(lr)
-                upd = leaf_values_by_row(vals, log.row_leaf, vals.shape[0]) \
-                    * (log.num_splits > 0)
-                if K > 1:
-                    score = score.at[:, c].add(upd)
-                else:
-                    score = score + upd
+                with trace_phase("lgbtpu/score_update"):
+                    vals = log.leaf_value * jnp.float32(lr)
+                    upd = leaf_values_by_row(vals, log.row_leaf,
+                                             vals.shape[0]) \
+                        * (log.num_splits > 0)
+                    if K > 1:
+                        score = score.at[:, c].add(upd)
+                    else:
+                        score = score + upd
                 logs.append(_small(log, learner.hp.has_categorical))
             stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *logs) if K > 1 else logs[0]
             return score, cegb_used, wbuf, stacked
@@ -429,7 +432,12 @@ class FusedTrainer:
         it0 = gbdt.iter_ + (prev[1] if prev is not None else 0)
         pre_score = gbdt.train_score.score
         pre_used = self._used_dev()
-        with global_timer.timed("fused/dispatch"):
+        # host-side counters only — the dispatch stays async (no sync here;
+        # the real device wait is the logs transfer in _finalize)
+        telemetry.count("fused/blocks_dispatched")
+        telemetry.count("fused/iters_dispatched", k)
+        with global_timer.timed("fused/dispatch"), \
+                trace_phase("lgbtpu/fused_dispatch"):
             (score, used), logs = fn(pre_score, pre_used,
                                      gbdt._key, jnp.int32(it0),
                                      self.learner.bins, self.learner.meta,
@@ -458,11 +466,19 @@ class FusedTrainer:
         self._cegb_used_dev = pre_used
         self._pending = None
 
-    def flush(self) -> bool:
+    def flush(self, reason: str = "unspecified") -> bool:
         """Finalize the in-flight block (if any) and sync host-side state.
-        Returns True when the finalized block ended all-constant."""
+        Returns True when the finalized block ended all-constant.
+
+        ``reason`` names which read API forced the flush (predict,
+        model_to_string, train_end, ...) — counted under
+        ``fused/flush/<reason>`` only when a block was actually in flight,
+        so the counters show exactly which entry points break the
+        pipeline's one-block overlap."""
         pending = self._pending
         self._pending = None
+        if pending is not None:
+            telemetry.count("fused/flush/" + reason)
         try:
             stopped = self._finalize(pending)
         except BaseException:
@@ -496,7 +512,8 @@ class FusedTrainer:
         last_iter_constant = False
         trees = []
         try:
-            with global_timer.timed("fused/logs_transfer"):
+            with global_timer.timed("fused/logs_transfer"), \
+                    trace_phase("lgbtpu/fused_flush"):
                 host = jax.device_get(logs)
             t_host0 = time.perf_counter()
             for i in range(k):
@@ -517,7 +534,36 @@ class FusedTrainer:
         # atomic commit: models/iter_ move together only on full success
         gbdt.models.extend(trees)
         gbdt.iter_ += k
+        self._count_trees(trees)
         return last_iter_constant
+
+    def _count_trees(self, trees) -> None:
+        """Host-side growth/launch accounting for a finalized block. Runs
+        AFTER the logs transfer (no extra sync): splits/leaves come off the
+        already-fetched host trees; partition/histogram launch counts
+        follow the builder's contract — one partition pass and one
+        smaller-child histogram per split, plus one root histogram per
+        tree on the rows layout (planes/resident fold the root histogram
+        into the pack pass)."""
+        splits = sum(t.num_leaves - 1 for t in trees)
+        leaves = sum(t.num_leaves for t in trees)
+        telemetry.count("tree/trees", len(trees))
+        telemetry.count("tree/splits", splits)
+        telemetry.count("tree/leaves", leaves)
+        try:
+            spec = self.learner.traffic_spec()
+        except Exception:
+            spec = None
+        root_hists = 0 if (spec and spec["work_layout"] != "rows") \
+            else len(trees)
+        telemetry.count("learner/partition_launches", splits)
+        telemetry.count("learner/hist_launches", splits + root_hists)
+        if spec:
+            telemetry.gauge("traffic/work_layout", spec["work_layout"])
+            telemetry.gauge("traffic/partition_bytes_per_row",
+                            spec["partition_bytes_per_row"])
+            telemetry.gauge("traffic/hist_bytes_per_row",
+                            spec["hist_bytes_per_row"])
 
     def _host_tree(self, host: BlockLogs, pick):
         from .tree import Tree

@@ -32,6 +32,7 @@ import numpy as np
 
 from .config import Config
 from .dataset import BinnedDataset
+from .obs import trace_phase
 from .ops.histogram import build_histogram
 from .ops.split import (
     FeatureMeta,
@@ -749,9 +750,11 @@ def build_tree_partitioned(
             # the slim work buffer carries route/ridx/g/h/c only
             if bins_res is None:
                 bins_res = resident_bin_planes(bins, guard, work.shape[2])
-            work, root_hist_loc = pack_resident_fold_root(
-                work, bins, ghc, guard, num_bins=bm,
-                exact=hist_mode != "bf16", chunk=hist_chunk, lo_w=hist_lo)
+            with trace_phase("lgbtpu/pack"):
+                work, root_hist_loc = pack_resident_fold_root(
+                    work, bins, ghc, guard, num_bins=bm,
+                    exact=hist_mode != "bf16", chunk=hist_chunk,
+                    lo_w=hist_lo)
 
             def part_fn(work, plane, start, cnt, feat, table, *, ch):
                 # gather the split feature's resident bin bytes through the
@@ -766,9 +769,11 @@ def build_tree_partitioned(
                 return base_part(work, plane, start, cnt, jnp.int32(0),
                                  table, ch=ch)
         else:
-            work, root_hist_loc = pack_planes_fold_root(
-                work, bins, ghc, guard, num_bins=bm,
-                exact=hist_mode != "bf16", chunk=hist_chunk, lo_w=hist_lo)
+            with trace_phase("lgbtpu/pack"):
+                work, root_hist_loc = pack_planes_fold_root(
+                    work, bins, ghc, guard, num_bins=bm,
+                    exact=hist_mode != "bf16", chunk=hist_chunk,
+                    lo_w=hist_lo)
             part_fn = base_part
     else:
         pad = ((guard, guard), (0, 0))
@@ -777,11 +782,13 @@ def build_tree_partitioned(
             # before any collective, so shards may scale independently
             gscale = 127.0 / (jnp.max(jnp.abs(ghc[:, 0])) + 1e-12)
             hscale = 127.0 / (jnp.max(jnp.abs(ghc[:, 1])) + 1e-12)
-            work0 = pack_rows_quantized(
-                jnp.pad(bins, pad), jnp.pad(ghc, pad),
-                jax.random.fold_in(key, 987123), gscale, hscale)
+            with trace_phase("lgbtpu/pack"):
+                work0 = pack_rows_quantized(
+                    jnp.pad(bins, pad), jnp.pad(ghc, pad),
+                    jax.random.fold_in(key, 987123), gscale, hscale)
         else:
-            work0 = pack_rows(jnp.pad(bins, pad), jnp.pad(ghc, pad))
+            with trace_phase("lgbtpu/pack"):
+                work0 = pack_rows(jnp.pad(bins, pad), jnp.pad(ghc, pad))
         if work_buf is not None:
             # reuse the caller's ping-pong pair (fused blocks carry it
             # across trees): only plane 0's used columns need writing —
@@ -947,8 +954,9 @@ def build_tree_partitioned(
         # hist_of over the root segment: same chunking, same einsum order)
         root_hist = comm.hist(root_hist_loc)
     else:
-        root_hist, work = hist_of(work, jnp.int32(0), jnp.int32(guard),
-                                  jnp.int32(n))
+        with trace_phase("lgbtpu/root_hist"):
+            root_hist, work = hist_of(work, jnp.int32(0), jnp.int32(guard),
+                                      jnp.int32(n))
     # the pool is kept FLAT per leaf: 4-D pools make XLA's layout
     # assignment disagree between the while carry and the gather/update
     # consumers, inserting a full pool copy per split (measured 2x430 us at
@@ -989,13 +997,14 @@ def build_tree_partitioned(
     # variant of the whole reduce-window/select pipeline
     root_ix = jnp.array([0], jnp.int32)
     best = _empty_best(num_leaves, num_bin)
-    root_info = node_best_pair(
-        0, root_ix, root_hist[None], root_sum[None], root_sum_loc[None],
-        leaf_out[:1], leaf_lower[:1], leaf_upper[:1], leaf_used[0],
-        tree_used0, jnp.int32(0),
-        *((jax.tree.map(lambda a: a[None],
-                        _adv_bounds_of(adv0, jnp.int32(0))),)
-          if hp.mono_advanced else ()))
+    with trace_phase("lgbtpu/split_scan"):
+        root_info = node_best_pair(
+            0, root_ix, root_hist[None], root_sum[None], root_sum_loc[None],
+            leaf_out[:1], leaf_lower[:1], leaf_upper[:1], leaf_used[0],
+            tree_used0, jnp.int32(0),
+            *((jax.tree.map(lambda a: a[None],
+                            _adv_bounds_of(adv0, jnp.int32(0))),)
+              if hp.mono_advanced else ()))
     best = jax.tree.map(lambda b, v: b.at[root_ix].set(v), best, root_info)
     log = TreeLog(
         num_splits=jnp.int32(0),
@@ -1127,8 +1136,9 @@ def build_tree_partitioned(
         parity = leaf_parity[leaf]
         split_col = bundle["group"][info.feature] if bundle is not None \
             else info.feature
-        work, lt = part_fn(work, parity, start, cnt, split_col,
-                           route_table(info), ch=part_chunk)
+        with trace_phase("lgbtpu/partition"):
+            work, lt = part_fn(work, parity, start, cnt, split_col,
+                               route_table(info), ch=part_chunk)
         new_parity = 1 - parity
 
         # ---- record ----
@@ -1226,7 +1236,9 @@ def build_tree_partitioned(
         left_smaller = info.left_sum[2] <= info.right_sum[2]
         small_start = jnp.where(left_smaller, start, start + lt)
         small_cnt = jnp.where(left_smaller, lt, cnt - lt)
-        hist_small, work = hist_of(work, new_parity, small_start, small_cnt)
+        with trace_phase("lgbtpu/histogram"):
+            hist_small, work = hist_of(work, new_parity, small_start,
+                                       small_cnt)
         parent_hist = hist_pool[leaf].reshape(num_grp, bm, 3)
         hist_large = parent_hist - hist_small
         hist_left = jnp.where(left_smaller, hist_small, hist_large)
@@ -1264,12 +1276,13 @@ def build_tree_partitioned(
             ab_r = _adv_bounds_of(adv, new_leaf)
             extra_pair = (jax.tree.map(lambda a, b: jnp.stack([a, b]),
                                        ab_l, ab_r),)
-        infos = node_best_pair(
-            r, pair, jnp.stack([hist_left, hist_right]),
-            jnp.stack([info.left_sum, info.right_sum]),
-            jnp.stack([loc_left, loc_right]), leaf_out[pair],
-            leaf_lower[pair], leaf_upper[pair], used_new, tree_used, d,
-            *extra_pair)
+        with trace_phase("lgbtpu/split_scan"):
+            infos = node_best_pair(
+                r, pair, jnp.stack([hist_left, hist_right]),
+                jnp.stack([info.left_sum, info.right_sum]),
+                jnp.stack([loc_left, loc_right]), leaf_out[pair],
+                leaf_lower[pair], leaf_upper[pair], used_new, tree_used, d,
+                *extra_pair)
         gates = jnp.stack([depth_ok(leaf_depth[leaf]),
                            depth_ok(leaf_depth[new_leaf])]) & valid
         infos = infos._replace(gain=jnp.where(gates, infos.gain, -jnp.inf))
@@ -1572,13 +1585,18 @@ class SerialTreeLearner:
             mode = config.tpu_hist_precision
             if config.use_quantized_grad:
                 mode = "int8"
+            backend = jax.default_backend()
             part_kernel = config.tpu_partition_kernel
             auto_kernel = part_kernel == "auto"
+            part_why = ""
             if auto_kernel:
                 # the fused DMA kernel needs Mosaic; CPU test meshes and
                 # non-TPU backends use the portable XLA pipeline
-                part_kernel = "pallas" if jax.default_backend() in (
-                    "tpu", "axon") else "xla"
+                part_kernel = "pallas" if backend in ("tpu", "axon") else "xla"
+                part_why = ("backend %s has Mosaic: fused DMA kernel"
+                            % backend if part_kernel == "pallas" else
+                            "backend %s has no Mosaic: portable XLA pipeline"
+                            % backend)
             from .ops.partition import GH_BYTES, GH_BYTES_Q
             row_w = self.bins.shape[1] + (GH_BYTES_Q if mode == "int8"
                                           else GH_BYTES)
@@ -1591,6 +1609,8 @@ class SerialTreeLearner:
                         "<= 512 bytes (got %d); using the XLA kernel",
                         row_w)
                 part_kernel = "xla"
+                part_why = ("packed row %d B exceeds the 512 B pallas DMA "
+                            "window" % row_w)
             part_chunk = int(config.tpu_part_chunk)
             if part_chunk <= 0:
                 # measured on v5e: the XLA path optimum is 2048 (per-op
@@ -1610,7 +1630,8 @@ class SerialTreeLearner:
                 # than 2048 at F=137
                 hist_chunk = 4096 if self.bins.shape[1] <= 64 else 1024
             hist_kernel = config.tpu_hist_kernel
-            if hist_kernel == "auto":
+            auto_hist = hist_kernel == "auto"
+            if auto_hist:
                 # auto = xla: the in-VMEM pallas kernel is bit-identical
                 # and ~6x faster standalone, but in-situ (alternating with
                 # the partition kernel inside the tree while-loop) the axon
@@ -1632,14 +1653,27 @@ class SerialTreeLearner:
                 Log.fatal("tpu_hist_chunk must be a multiple of 32 with "
                           "the pallas histogram kernel (got %d)", hist_chunk)
             layout = config.tpu_work_layout
-            if layout == "auto":
+            auto_layout = layout == "auto"
+            layout_why = ""
+            if auto_layout:
                 # planes pay off when a packed row wastes most of a
                 # 128-lane DMA tile; at > 256 B row-major tiles are already
                 # >= 2-tile efficient. int8 keeps rows (no quantized planes
                 # pack pass yet)
                 layout = "planes" if (
-                    jax.default_backend() in ("tpu", "axon")
+                    backend in ("tpu", "axon")
                     and row_w <= 256 and mode != "int8") else "rows"
+                if layout == "planes":
+                    layout_why = ("packed row %d B <= 256 B on %s: plane "
+                                  "tiles waste fewer DMA lanes" % (row_w,
+                                                                   backend))
+                elif backend not in ("tpu", "axon"):
+                    layout_why = "backend %s: row-major default" % backend
+                elif mode == "int8":
+                    layout_why = "int8 mode has no quantized planes pack"
+                else:
+                    layout_why = ("packed row %d B > 256 B: row tiles "
+                                  "already >= 2-tile efficient" % row_w)
             elif layout == "planes" and mode == "int8":
                 Log.warning("tpu_work_layout=planes does not support int8 "
                             "quantized training; using rows")
@@ -1655,7 +1689,7 @@ class SerialTreeLearner:
                               "are hilo/bf16 only)")
                 layout = "resident"
             elif rs == "auto" and layout == "planes" \
-                    and jax.default_backend() in ("tpu", "axon"):
+                    and backend in ("tpu", "axon"):
                 # resident state strictly reduces partition traffic where
                 # the planes layout already wins, and trees stay
                 # bit-identical; CPU meshes keep plain planes (the gather
@@ -1678,6 +1712,34 @@ class SerialTreeLearner:
                 Log.fatal("planes layout needs tpu_part_chunk a multiple "
                           "of 128 and, above 256, of the 256-row "
                           "compaction sub-block (got %d)", part_chunk)
+            # auto-knob resolution records: what auto chose and why
+            # (deduped, so repeated build_kwargs calls keep one record per
+            # distinct resolution)
+            from .obs import telemetry
+
+            def _rec(knob, value, reason):
+                telemetry.record("auto_resolution",
+                                 dedupe_key=(knob, value, reason),
+                                 knob=knob, configured="auto",
+                                 value=value, reason=reason)
+
+            if auto_kernel:
+                _rec("tpu_partition_kernel", part_kernel, part_why)
+            if auto_hist:
+                _rec("tpu_hist_kernel", hist_kernel,
+                     "in-situ pallas hits the slow axon dispatch path; "
+                     "the XLA einsum wins wall-clock")
+            if auto_layout:
+                _rec("tpu_work_layout", layout if layout != "resident"
+                     else "planes", layout_why)
+            if rs == "auto":
+                _rec("tpu_resident_state",
+                     "resident" if layout == "resident" else "off",
+                     "planes layout on %s: resident gather strictly "
+                     "reduces partition traffic" % backend
+                     if layout == "resident" else
+                     "layout %s on %s: resident gather has no payoff"
+                     % (layout, backend))
             kw.update(
                 hist_chunk=hist_chunk,
                 part_chunk=part_chunk,

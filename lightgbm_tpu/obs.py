@@ -1,0 +1,278 @@
+"""Telemetry: trusted timers, phase tracing, structured run counters.
+
+Codifies PERF.md "measurement discipline v2" as a library instead of a
+per-script convention. The facts the primitives encode (each reproduced
+multiple times on the v5e/axon terminal, PERF.md rounds 5-7):
+
+- the device profiler MODELS custom-call costs, it does not measure them —
+  wall clocks are the only trusted ground truth for Pallas kernels;
+- ``block_until_ready`` does not reliably synchronize through the tunnel;
+  only a real transfer (``device_get`` / ``np.asarray``) does, so every
+  trusted wall must end in :func:`sync`;
+- identical re-executions can be deduplicated by the tunnel, so A/B loops
+  must thread a CHANGING carry (:func:`ab_interleaved` documents and
+  enforces the protocol shape);
+- the device clock drifts between runs — only same-process interleaved
+  comparisons are trusted.
+
+Three layers:
+
+1. **Trusted timing** — :func:`sync`, :func:`wall`, :func:`timed_sync`,
+   :func:`ab_interleaved`. ``bench.py`` and the ``scripts/*_bisect.py`` /
+   ``scripts/profile_wall.py`` harnesses build on these.
+2. **Phase tracing** — :func:`trace_phase` wraps a region in
+   ``jax.named_scope`` + ``jax.profiler.TraceAnnotation`` so profiler
+   timelines and HLO dumps carry the learner's phase names (pack,
+   histogram, split_scan, partition, score_update, fused dispatch/flush).
+   Both are trace/metadata-only: they never change the computed values.
+3. **Structured run counters** — the process-global :data:`telemetry`
+   registry (counters / gauges / timers / record lists) instrumenting the
+   dataset device caches, the fused pipeline, per-tree growth stats and
+   every ``auto`` knob resolution. ``Booster.telemetry()``,
+   ``CallbackEnv.telemetry``, ``cli --dump-telemetry`` and the bench JSON
+   all read :meth:`Telemetry.snapshot`.
+
+All counter updates run on HOST, outside traced code, and never add a
+device sync: telemetry keeps bit-parity with an uninstrumented run.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from collections import defaultdict
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple
+
+
+# ---------------------------------------------------------------------------
+# Trusted timing primitives (PERF.md measurement discipline v2)
+# ---------------------------------------------------------------------------
+
+def sync(x) -> Optional[Any]:
+    """Force a REAL 1-element device->host transfer dependent on ``x``.
+
+    ``block_until_ready`` can return without the tunnel having executed
+    anything (discipline v2 fact 2); an actual transfer cannot. The first
+    jax.Array leaf of ``x`` (any pytree) is reduced to one element ON
+    DEVICE and ``device_get`` pulled — completing it forces every producer
+    of that leaf to have run. Returns the fetched 1-element array, or None
+    when ``x`` holds no device arrays (host values need no sync).
+    """
+    import jax
+    for leaf in jax.tree.leaves(x):
+        if isinstance(leaf, jax.Array):
+            return jax.device_get(leaf.ravel()[:1])
+    return None
+
+
+class WallTimer:
+    """Result handle yielded by :func:`wall`; ``seconds`` is set on exit."""
+
+    __slots__ = ("name", "seconds")
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self.seconds = 0.0
+
+
+@contextlib.contextmanager
+def wall(name: str, record: bool = True) -> Iterator[WallTimer]:
+    """Monotonic (``perf_counter``) wall timer around a block.
+
+    Callers timing device work must end the block with ``obs.sync(result)``
+    — the timer cannot know what to sync on. The elapsed time lands on the
+    yielded handle's ``.seconds`` and (when ``record``) in the global
+    telemetry registry under ``wall/<name>``.
+    """
+    w = WallTimer(name)
+    t0 = time.perf_counter()
+    try:
+        yield w
+    finally:
+        w.seconds = time.perf_counter() - t0
+        if record:
+            telemetry.add_time("wall/" + name, w.seconds)
+
+
+def timed_sync(fn: Callable[[], Any]) -> float:
+    """Trusted wall of one call of ``fn``: warm (compile) once, then time a
+    second call ended by a forced 1-element transfer of its result."""
+    import jax
+    r = fn()
+    jax.block_until_ready(r)       # warm/compiled; the real sync is below
+    t0 = time.perf_counter()
+    sync(fn())
+    return time.perf_counter() - t0
+
+
+def ab_interleaved(fns: Sequence[Tuple[str, Callable[[int], Callable[[], Any]]]],
+                   reps: int = 5, k: int = 4) -> Dict[str, float]:
+    """Interleaved A/B per-op timing under discipline v2.
+
+    ``fns`` is ``[(name, make)]`` where ``make(j)`` returns a zero-arg
+    thunk running a j-chained computation (e.g. a ``lax.scan`` of length j)
+    whose body threads a CHANGING carry — bit-identical re-executions can
+    be deduplicated by the tunnel (fact 3), so the chain must mutate state
+    between links. Per-op time = (t_k - t_1) / (k - 1), which cancels the
+    dispatch + sync overhead shared by both chain lengths; trials are
+    interleaved A, B, A, B per rep (the device clock drifts between runs)
+    and the best of ``reps`` is kept. Everything is compiled before the
+    first timed trial. Returns ``{name: per_op_seconds}``.
+    """
+    if k < 2:
+        raise ValueError("ab_interleaved needs chain length k >= 2")
+    pairs = {name: (make(1), make(k)) for name, make in fns}
+    for f1, fk in pairs.values():          # compile everything first
+        timed_sync(f1), timed_sync(fk)
+    best = {name: float("inf") for name, _ in fns}
+    for _ in range(reps):
+        for name, (f1, fk) in pairs.items():   # A, B, A, B ... per rep
+            best[name] = min(best[name],
+                             (timed_sync(fk) - timed_sync(f1)) / (k - 1))
+    return best
+
+
+# ---------------------------------------------------------------------------
+# Phase tracing
+# ---------------------------------------------------------------------------
+
+@contextlib.contextmanager
+def trace_phase(name: str) -> Iterator[None]:
+    """Name a hot-phase region for profiler traces and HLO dumps.
+
+    Inside a jit trace, ``jax.named_scope`` stamps the phase name onto the
+    emitted HLO ops; on host, ``jax.profiler.TraceAnnotation`` marks the
+    span on the profiler timeline. Both are metadata-only — no runtime
+    effect on the computed values, so phase-traced trees stay bit-identical
+    (tests/test_obs.py rides the existing parity shapes).
+    """
+    import jax
+    try:
+        ann = jax.profiler.TraceAnnotation(name)
+    except Exception:  # pragma: no cover - profiler backend unavailable
+        ann = contextlib.nullcontext()
+    with jax.named_scope(name), ann:
+        yield
+
+
+# ---------------------------------------------------------------------------
+# Structured run counters
+# ---------------------------------------------------------------------------
+
+def _jsonable(v):
+    """Coerce numpy scalars / arrays so snapshot() survives json.dumps."""
+    if isinstance(v, (str, bool, int, float)) or v is None:
+        return v
+    if isinstance(v, dict):
+        return {str(k): _jsonable(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    if hasattr(v, "item"):       # numpy / jax scalar
+        try:
+            return v.item()
+        except Exception:
+            pass
+    if hasattr(v, "tolist"):
+        return v.tolist()
+    return repr(v)
+
+
+class Telemetry:
+    """Process-global registry of counters, gauges, timers and records.
+
+    Thread-safe (the mesh learners and user callbacks may touch it from
+    worker threads) and cheap: every mutation is a dict update under one
+    lock, on host, never inside traced code. ``snapshot()`` returns a
+    plain JSON-serializable dict and folds in ``utils.timer.global_timer``
+    so the long-standing phase timers (fused/block_fn, fused/dispatch,
+    fused/logs_transfer, ...) appear without double bookkeeping.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[str, int] = defaultdict(int)
+        self._gauges: Dict[str, Any] = {}
+        self._timers: Dict[str, float] = defaultdict(float)
+        self._timer_calls: Dict[str, int] = defaultdict(int)
+        self._records: Dict[str, List[dict]] = defaultdict(list)
+
+    # -- mutation --
+    def count(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counters[name] += int(n)
+
+    def gauge(self, name: str, value) -> None:
+        with self._lock:
+            self._gauges[name] = _jsonable(value)
+
+    def add_time(self, name: str, seconds: float) -> None:
+        with self._lock:
+            self._timers[name] += float(seconds)
+            self._timer_calls[name] += 1
+
+    @contextlib.contextmanager
+    def timed(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.add_time(name, time.perf_counter() - t0)
+
+    def record(self, name: str, dedupe_key=None, **payload) -> None:
+        """Append a structured event to the ``name`` list. With
+        ``dedupe_key``, an event carrying the same key is appended at most
+        once (auto-knob resolutions re-run per build_kwargs call but the
+        registry keeps one record per distinct resolution)."""
+        with self._lock:
+            lst = self._records[name]
+            if dedupe_key is not None:
+                key = _jsonable(dedupe_key)
+                if any(r.get("_key") == key for r in lst):
+                    return
+                payload = dict(payload, _key=key)
+            lst.append(_jsonable(payload))
+
+    # -- read --
+    def counter(self, name: str) -> int:
+        with self._lock:
+            return self._counters.get(name, 0)
+
+    def records(self, name: str) -> List[dict]:
+        with self._lock:
+            return list(self._records.get(name, []))
+
+    def snapshot(self, include_global_timer: bool = True) -> Dict[str, Any]:
+        """JSON-serializable view of everything recorded so far."""
+        with self._lock:
+            timers = {k: round(v, 6) for k, v in self._timers.items()}
+            calls = dict(self._timer_calls)
+            snap = {
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+                "timers": timers,
+                "timer_calls": calls,
+                "records": {k: [dict(r) for r in v]
+                            for k, v in self._records.items()},
+            }
+        if include_global_timer:
+            from .utils.timer import global_timer
+            for k, v in global_timer.times.items():
+                snap["timers"].setdefault(k, round(float(v), 6))
+        for lst in snap["records"].values():
+            for r in lst:
+                r.pop("_key", None)
+        return snap
+
+    def reset(self) -> None:
+        """Clear every counter/gauge/timer/record (tests, fresh benches).
+        ``utils.timer.global_timer`` is owned by its callers and is NOT
+        reset here."""
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._timers.clear()
+            self._timer_calls.clear()
+            self._records.clear()
+
+
+telemetry = Telemetry()

@@ -464,10 +464,12 @@ def hist16_segment(work: jax.Array, plane, start, cnt, *,
         cgm = cg * valid[:, None].astype(jnp.float32)
         return acc + _hist16_chunk(cb, cgm, num_bins, exact, lo_w)
 
-    acc = jax.lax.fori_loop(
-        0, nchunks, body,
-        jnp.zeros((f, sh, lo_w * nch), jnp.float32))
-    return _hist16_combine(acc, num_bins, exact, lo_w)
+    # named_scope: metadata-only op annotation for profiler/HLO attribution
+    with jax.named_scope("lgbtpu/ops/hist16_segment"):
+        acc = jax.lax.fori_loop(
+            0, nchunks, body,
+            jnp.zeros((f, sh, lo_w * nch), jnp.float32))
+        return _hist16_combine(acc, num_bins, exact, lo_w)
 
 
 # ---------------------------------------------------------------------------
@@ -539,10 +541,11 @@ def hist16_segment_planes(work: jax.Array, plane, start, cnt, *,
         cgm = cg * valid[None, :].astype(jnp.float32)
         return acc + _hist16_chunk_planes(cb, cgm, num_bins, exact, lo_w)
 
-    acc = jax.lax.fori_loop(
-        0, nchunks, body,
-        jnp.zeros((f, sh, lo_w * nch), jnp.float32))
-    return _hist16_combine(acc, num_bins, exact, lo_w)
+    with jax.named_scope("lgbtpu/ops/hist16_segment_planes"):
+        acc = jax.lax.fori_loop(
+            0, nchunks, body,
+            jnp.zeros((f, sh, lo_w * nch), jnp.float32))
+        return _hist16_combine(acc, num_bins, exact, lo_w)
 
 
 def hist16_segment_resident(work: jax.Array, resident: jax.Array, plane,
@@ -582,10 +585,11 @@ def hist16_segment_resident(work: jax.Array, resident: jax.Array, plane,
         cgm = cg * valid[None, :].astype(jnp.float32)
         return acc + _hist16_chunk_planes(cb, cgm, num_bins, exact, lo_w)
 
-    acc = jax.lax.fori_loop(
-        0, nchunks, body,
-        jnp.zeros((f, sh, lo_w * nch), jnp.float32))
-    return _hist16_combine(acc, num_bins, exact, lo_w)
+    with jax.named_scope("lgbtpu/ops/hist16_segment_resident"):
+        acc = jax.lax.fori_loop(
+            0, nchunks, body,
+            jnp.zeros((f, sh, lo_w * nch), jnp.float32))
+        return _hist16_combine(acc, num_bins, exact, lo_w)
 
 
 def _hist_pallas_kernel_planes(sref, work_in, work_ref, acc_ref, cin, acc_s,

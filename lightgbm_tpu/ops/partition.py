@@ -200,9 +200,10 @@ def partition_segment(
         work = blend_at(work, rcur - ch, False)
         return work, lcur + nl, rcur - nr
 
-    work, lcur, _ = jax.lax.fori_loop(
-        0, nchunks, body, (work, start, start + cnt))
-    return work, lcur - start
+    with jax.named_scope("lgbtpu/ops/partition_segment"):
+        work, lcur, _ = jax.lax.fori_loop(
+            0, nchunks, body, (work, start, start + cnt))
+        return work, lcur - start
 
 
 # ---------------------------------------------------------------------------
@@ -307,9 +308,10 @@ def partition_segment_planes(
         work = blend_at(work, rcur - ch, False)
         return work, lcur + nl, rcur - nr
 
-    work, lcur, _ = jax.lax.fori_loop(
-        0, nchunks, body, (work, start, start + cnt))
-    return work, lcur - start
+    with jax.named_scope("lgbtpu/ops/partition_segment_planes"):
+        work, lcur, _ = jax.lax.fori_loop(
+            0, nchunks, body, (work, start, start + cnt))
+        return work, lcur - start
 
 
 def pack_planes_fold_root(work: jax.Array, bins: jax.Array, ghc: jax.Array,

@@ -23,15 +23,44 @@ _DEBUG = 2
 
 _LEVEL_NAMES = {_FATAL: "Fatal", _WARNING: "Warning", _INFO: "Info", _DEBUG: "Debug"}
 
+# The DEFAULT level/sink are process-global: verbosity configured on the
+# main thread (train(params={"verbosity": ...}), register_logger) must hold
+# in worker threads too — a purely thread-local default silently reverted
+# to INFO/stderr inside mesh/multiprocess workers. ``_state`` carries an
+# optional per-thread OVERRIDE on top (set_thread_log_level/_sink), used by
+# tests and embedders that need one thread quieter than the process.
+_default_level: int = _INFO
+_default_sink: Optional[Callable[[str], None]] = None
+
 _state = threading.local()
 
 
 def _get_level() -> int:
-    return getattr(_state, "level", _INFO)
+    return getattr(_state, "level", _default_level)
 
 
 def _get_sink() -> Optional[Callable[[str], None]]:
-    return getattr(_state, "sink", None)
+    return getattr(_state, "sink", _default_sink)
+
+
+def set_thread_log_level(level: Optional[int]) -> None:
+    """Per-thread level override; None clears it (falls back to the
+    process-global default set by ``Log.reset_log_level``)."""
+    if level is None:
+        if hasattr(_state, "level"):
+            del _state.level
+    else:
+        _state.level = level
+
+
+def set_thread_log_sink(sink: Optional[Callable[[str], None]],
+                        clear: bool = False) -> None:
+    """Per-thread sink override; ``clear=True`` removes the override."""
+    if clear:
+        if hasattr(_state, "sink"):
+            del _state.sink
+    else:
+        _state.sink = sink
 
 
 class Log:
@@ -44,11 +73,19 @@ class Log:
 
     @staticmethod
     def reset_log_level(level: int) -> None:
-        _state.level = level
+        """Set the PROCESS-GLOBAL default level (the reference's
+        ResetLogLevel is likewise global); worker threads inherit it.
+        Use ``set_thread_log_level`` for a per-thread override."""
+        global _default_level
+        _default_level = level
 
     @staticmethod
     def reset_callback(sink: Optional[Callable[[str], None]]) -> None:
-        _state.sink = sink
+        """Set the PROCESS-GLOBAL sink (``register_logger`` semantics:
+        one registered logger serves every thread). Use
+        ``set_thread_log_sink`` for a per-thread override."""
+        global _default_sink
+        _default_sink = sink
 
     @staticmethod
     def _write(level: int, msg: str) -> None:

@@ -22,7 +22,6 @@ Usage: python scripts/layout_bisect.py [n_rows] [num_feat]
 """
 import os
 import sys
-import time
 from functools import partial
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -34,6 +33,7 @@ import numpy as np
 jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
+from lightgbm_tpu import obs
 from lightgbm_tpu.ops import partition as P
 from lightgbm_tpu.ops.histogram import hist16_segment, hist16_segment_planes
 
@@ -43,34 +43,9 @@ REPS = 5
 K = 4
 
 
-def timed(fn):
-    r = fn()
-    jax.block_until_ready(r)          # warm/compiled; sync is forced below
-    t0 = time.perf_counter()
-    r = fn()
-    _ = np.asarray(jax.tree.leaves(r)[0]).ravel()[:1]   # real transfer sync
-    return time.perf_counter() - t0
-
-
 def chain_per_op(make):
-    """Best-of-REPS (t_K - t_1)/(K - 1) for a chained-scan bench."""
-    f1, fK = make(1), make(K)
-    best = np.inf
-    for _ in range(REPS):
-        best = min(best, (timed(fK) - timed(f1)) / (K - 1))
-    return best
-
-
-def interleaved(pairs):
-    """[(name, make)] -> {name: per_op}, trials interleaved across sides."""
-    fns = {name: (make(1), make(K)) for name, make in pairs}
-    for f1, fK in fns.values():      # compile everything first
-        timed(f1), timed(fK)
-    best = {name: np.inf for name, _ in pairs}
-    for _ in range(REPS):
-        for name, (f1, fK) in fns.items():   # A, B, A, B ... per rep
-            best[name] = min(best[name], (timed(fK) - timed(f1)) / (K - 1))
-    return best
+    """Best-of-REPS (t_K - t_1)/(K - 1) for one chained-scan bench."""
+    return obs.ab_interleaved([("x", make)], reps=REPS, k=K)["x"]
 
 
 def build_inputs(n, f, num_bin=256, seed=0):
@@ -206,7 +181,7 @@ def main(n, f):
         ("pack+root/planes(folded)",
          pack_make_planes(bins, ghc, guard, n, f, work_p.shape)),
     ]
-    res = interleaved(pairs)
+    res = obs.ab_interleaved(pairs, reps=REPS, k=K)
     for name, per in res.items():
         print(f"{name:28s} {per * 1e3:8.3f} ms  ({n / per / 1e6:7.1f} M rows/s)")
     for stem in ("part", "hist", "pack+root"):

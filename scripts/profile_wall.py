@@ -9,7 +9,6 @@ Usage: python scripts/profile_wall.py [N_ROWS] [N_ITER]
 """
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -24,53 +23,60 @@ def main():
     import jax
     jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    t_imp0 = time.perf_counter()
-    import lightgbm_tpu as lgb
-    from lightgbm_tpu.utils.timer import global_timer
-    t_import = time.perf_counter() - t_imp0
+    from lightgbm_tpu import obs
+    with obs.wall("profile/import") as w:
+        import lightgbm_tpu as lgb
+        from lightgbm_tpu.utils.timer import global_timer
+    t_import = w.seconds
 
     rng = np.random.RandomState(7)
-    t0 = time.perf_counter()
-    X = rng.randn(N, 28).astype(np.float32)
-    w = rng.randn(28) / np.sqrt(28)
-    logit = X @ w + 0.5 * np.sin(X[:, 0] * 2) * X[:, 1] + 0.3 * rng.randn(N)
-    y = (logit > 0).astype(np.float64)
-    X = X.astype(np.float64)
-    t_datagen = time.perf_counter() - t0
+    with obs.wall("profile/datagen") as wt:
+        X = rng.randn(N, 28).astype(np.float32)
+        w = rng.randn(28) / np.sqrt(28)
+        logit = X @ w + 0.5 * np.sin(X[:, 0] * 2) * X[:, 1] \
+            + 0.3 * rng.randn(N)
+        y = (logit > 0).astype(np.float64)
+        X = X.astype(np.float64)
+    t_datagen = wt.seconds
 
     params = {
         "objective": "binary", "num_leaves": 255, "max_bin": 255,
         "learning_rate": 0.1, "verbosity": -1, "metric": ["auc"],
         "tpu_iter_block": BLOCK,
     }
-    t0 = time.perf_counter()
-    ds = lgb.Dataset(X, label=y)
-    ds.construct()
-    t_construct = time.perf_counter() - t0
+    with obs.wall("profile/construct") as wt:
+        ds = lgb.Dataset(X, label=y)
+        ds.construct()
+    t_construct = wt.seconds
 
+    # every train wall ends in a forced 1-element transfer of the score
+    # (obs.sync): block_until_ready alone does not reliably synchronize
     global_timer.reset()
-    t0 = time.perf_counter()
-    lgb.train(dict(params), ds, num_boost_round=BLOCK)
-    t_warmup = time.perf_counter() - t0
+    with obs.wall("profile/warmup") as wt:
+        wb = lgb.train(dict(params), ds, num_boost_round=BLOCK)
+        obs.sync(wb.inner.train_score.score)
+    t_warmup = wt.seconds
     warm_t = dict(global_timer.times)
 
     global_timer.reset()
-    t0 = time.perf_counter()
-    bst = lgb.train(dict(params), ds, num_boost_round=ITERS)
-    t_train = time.perf_counter() - t0
+    with obs.wall("profile/train") as wt:
+        bst = lgb.train(dict(params), ds, num_boost_round=ITERS)
+        obs.sync(bst.inner.train_score.score)
+    t_train = wt.seconds
     train_t = dict(global_timer.times)
 
     # pure device time of one cached block: re-dispatch through the booster
     # machinery and block on the result
     global_timer.reset()
-    t0 = time.perf_counter()
-    bst2 = lgb.train(dict(params), ds, num_boost_round=BLOCK)
-    t_train1 = time.perf_counter() - t0
+    with obs.wall("profile/train_warm_block") as wt:
+        bst2 = lgb.train(dict(params), ds, num_boost_round=BLOCK)
+        obs.sync(bst2.inner.train_score.score)
+    t_train1 = wt.seconds
     one_t = dict(global_timer.times)
 
-    t0 = time.perf_counter()
-    (_, _, auc, _), = bst.eval_train()
-    t_eval = time.perf_counter() - t0
+    with obs.wall("profile/eval_train") as wt:
+        (_, _, auc, _), = bst.eval_train()
+    t_eval = wt.seconds
 
     def fmt(d):
         return {k: round(v, 3) for k, v in sorted(d.items())}

@@ -27,7 +27,6 @@ Usage: python scripts/resident_bisect.py [n_rows] [num_feat] [train_rows]
 """
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -38,6 +37,7 @@ import numpy as np
 jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
+from lightgbm_tpu import obs
 from lightgbm_tpu.ops import partition as P
 from lightgbm_tpu.ops.histogram import (
     hist16_segment, hist16_segment_planes, hist16_segment_resident)
@@ -46,27 +46,6 @@ CH = 1024        # partition chunk (pallas optimum, PERF.md round 5)
 HCH = 4096       # histogram chunk
 REPS = 5
 K = 4
-
-
-def timed(fn):
-    r = fn()
-    jax.block_until_ready(r)          # warm/compiled; sync is forced below
-    t0 = time.perf_counter()
-    r = fn()
-    _ = np.asarray(jax.tree.leaves(r)[0]).ravel()[:1]   # real transfer sync
-    return time.perf_counter() - t0
-
-
-def interleaved(pairs):
-    """[(name, make)] -> {name: per_op}, trials interleaved across sides."""
-    fns = {name: (make(1), make(K)) for name, make in pairs}
-    for f1, fK in fns.values():      # compile everything first
-        timed(f1), timed(fK)
-    best = {name: np.inf for name, _ in pairs}
-    for _ in range(REPS):
-        for name, (f1, fK) in fns.items():   # A, B, A, B ... per rep
-            best[name] = min(best[name], (timed(fK) - timed(f1)) / (K - 1))
-    return best
 
 
 def build_inputs(n, f, num_bin=256, seed=0):
@@ -170,9 +149,11 @@ def train_wall(layout, resident, n, f, iters=10, seed=3):
     ds.construct()
     lgb.train(dict(params), ds, num_boost_round=5)        # warmup/compile
     def run():
-        t0 = time.perf_counter()
-        lgb.train(dict(params), ds, num_boost_round=iters)
-        return time.perf_counter() - t0
+        with obs.wall("bisect/train_" + ("resident" if resident else layout),
+                      record=False) as w:
+            bst = lgb.train(dict(params), ds, num_boost_round=iters)
+            obs.sync(bst.inner.train_score.score)   # trusted wall end
+        return w.seconds
     return run
 
 
@@ -226,7 +207,7 @@ def main(n, f, train_n):
         ("hist/resident/xla",
          hist_make(hist16_segment_resident, work_s, guard, n, f, 1, res)),
     ]
-    res_t = interleaved(pairs)
+    res_t = obs.ab_interleaved(pairs, reps=REPS, k=K)
     print()
     for name, per in res_t.items():
         print(f"{name:24s} {per * 1e3:8.3f} ms  ({n / per / 1e6:7.1f} M rows/s)")

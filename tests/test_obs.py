@@ -1,0 +1,285 @@
+"""Telemetry subsystem: obs primitives, run counters, exposure surfaces.
+
+Covers the registry round-trip, the dataset device-cache hit/miss/
+invalidation counters over repeated trains, the auto-knob resolution
+records, CallbackEnv.telemetry during log_evaluation, the bit-parity
+guarantee (telemetry never perturbs trained trees), the utils.log
+thread-default regression, and the "no naked time.time() walls" grep over
+the migrated timing harnesses.
+"""
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import obs
+from lightgbm_tpu.obs import Telemetry, telemetry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _data(n=400, f=6, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, f)
+    y = (X[:, 0] + X[:, 1] > 1).astype(np.float64)
+    return X, y
+
+
+PARAMS = {"objective": "binary", "num_leaves": 7, "min_data_in_leaf": 5,
+          "verbosity": -1}
+
+
+# ---------------------------------------------------------------- registry
+
+def test_registry_snapshot_roundtrip():
+    t = Telemetry()
+    t.count("a/b")
+    t.count("a/b", 3)
+    t.gauge("g", np.int64(7))          # numpy scalars must serialize
+    t.add_time("t", 0.25)
+    with t.timed("t"):
+        pass
+    t.record("ev", knob="k", value=np.float32(1.5))
+    t.record("dd", dedupe_key=("x", 1), v=1)
+    t.record("dd", dedupe_key=("x", 1), v=1)   # deduped
+    t.record("dd", dedupe_key=("x", 2), v=2)
+    snap = t.snapshot(include_global_timer=False)
+    parsed = json.loads(json.dumps(snap))      # must survive json round-trip
+    assert parsed["counters"]["a/b"] == 4
+    assert parsed["gauges"]["g"] == 7
+    assert parsed["timers"]["t"] >= 0.25
+    assert parsed["timer_calls"]["t"] == 2
+    assert parsed["records"]["ev"] == [{"knob": "k", "value": 1.5}]
+    assert len(parsed["records"]["dd"]) == 2
+    t.reset()
+    empty = t.snapshot(include_global_timer=False)
+    assert empty["counters"] == {} and empty["records"] == {}
+
+
+def test_wall_and_sync_primitives():
+    import jax.numpy as jnp
+    with obs.wall("obs_test/block", record=False) as w:
+        x = jnp.arange(8.0) * 2
+        got = obs.sync(x)
+    assert w.seconds > 0
+    assert got is not None and got.shape == (1,)
+    assert obs.sync({"host": 3}) is None       # no device leaves -> no-op
+
+
+def test_ab_interleaved_protocol():
+    import jax
+    import jax.numpy as jnp
+
+    def make(k):
+        @jax.jit
+        def f():
+            def body(c, _):
+                return c * 1.0000001 + 1.0, None   # changing carry
+            out, _ = jax.lax.scan(body, jnp.float32(0), None, length=k * 50)
+            return out.reshape(1)
+        return f
+
+    with pytest.raises(ValueError):
+        obs.ab_interleaved([("x", make)], k=1)
+    res = obs.ab_interleaved([("x", make)], reps=2, k=3)
+    assert set(res) == {"x"} and np.isfinite(res["x"])
+
+
+# ------------------------------------------------------------- hot path
+
+def test_train_telemetry_counters_and_auto_records():
+    X, y = _data()
+    ds = lgb.Dataset(X, label=y)
+    telemetry.reset()
+    bst = lgb.train(dict(PARAMS), ds, num_boost_round=4)
+    snap = bst.telemetry()
+    json.dumps(snap)                           # acceptance: serializable
+    c = snap["counters"]
+    # dataset device caches: first train uploads (misses), no hits yet
+    assert c["dataset/device_bins/miss"] >= 1
+    assert c["dataset/device_bins/upload_bytes"] > 0
+    # fused pipeline dispatched and flushed at train end
+    assert c["fused/blocks_dispatched"] >= 1
+    assert c["fused/iters_dispatched"] == 4
+    assert c["fused/flush/train_end"] == 1
+    # per-tree growth + launch accounting
+    assert c["tree/trees"] == 4
+    assert c["tree/leaves"] == c["tree/splits"] + c["tree/trees"]
+    assert c["learner/partition_launches"] == c["tree/splits"]
+    assert c["learner/hist_launches"] >= c["tree/splits"]
+    # phase timers nonzero after a CPU train
+    assert snap["timers"].get("fused/dispatch", 0) > 0
+    assert snap["timers"].get("fused/logs_transfer", 0) > 0
+    # one auto-resolution record per auto knob
+    knobs = {r["knob"]: r for r in snap["records"]["auto_resolution"]}
+    assert set(knobs) == {"tpu_partition_kernel", "tpu_hist_kernel",
+                          "tpu_work_layout", "tpu_resident_state"}
+    for r in knobs.values():
+        assert r["configured"] == "auto" and r["value"] and r["reason"]
+    assert "traffic/work_layout" in snap["gauges"]
+
+
+def test_second_train_hits_device_cache_and_bump_invalidates():
+    X, y = _data(seed=1)
+    ds = lgb.Dataset(X, label=y)
+    binned = ds.construct(dict(PARAMS))
+    lgb.train(dict(PARAMS), ds, num_boost_round=3)
+    telemetry.reset()
+    lgb.train(dict(PARAMS), ds, num_boost_round=3)
+    c = telemetry.snapshot(include_global_timer=False)["counters"]
+    assert c.get("dataset/device_bins/hit", 0) > 0      # acceptance bar
+    assert c.get("dataset/device_bins/miss", 0) == 0
+    # bump_version invalidates: next train re-uploads
+    binned.bump_version()
+    binned.metadata.bump_version()
+    telemetry.reset()
+    lgb.train(dict(PARAMS), ds, num_boost_round=3)
+    c = telemetry.snapshot(include_global_timer=False)["counters"]
+    assert c.get("dataset/device_bins/miss", 0) >= 1
+
+
+def test_read_api_flush_reasons():
+    X, y = _data(seed=2)
+    ds = lgb.Dataset(X, label=y)
+    telemetry.reset()
+    bst = lgb.train(dict(PARAMS), ds, num_boost_round=3)
+    bst.num_trees()
+    c = telemetry.snapshot(include_global_timer=False)["counters"]
+    # train() itself flushed at train_end; num_trees after that finds no
+    # in-flight block, so no fused/flush/num_trees is counted
+    assert c["fused/flush/train_end"] == 1
+    assert "fused/flush/num_trees" not in c
+    # model_to_string mid-block: drive the fused trainer manually
+    telemetry.reset()
+    bst2 = lgb.Booster(dict(PARAMS, tpu_iter_block=8), ds)
+    bst2.inner.train_block(4)                  # dispatch, leave in flight
+    bst2.inner.model_to_string()
+    c = telemetry.snapshot(include_global_timer=False)["counters"]
+    assert c.get("fused/flush/model_to_string", 0) == 1
+
+
+def test_callback_env_carries_telemetry():
+    X, y = _data(seed=3)
+    ds = lgb.Dataset(X, label=y)
+    seen = []
+
+    def spy(env):
+        seen.append(env.telemetry)
+
+    spy.order = 20
+    lgb.train(dict(PARAMS), ds, num_boost_round=3, valid_sets=[ds],
+              valid_names=["train"],
+              callbacks=[lgb.log_evaluation(period=1), spy])
+    assert len(seen) == 3
+    assert all(t is telemetry for t in seen)
+    # positional 6-field construction stays valid (telemetry defaults None)
+    env = lgb.callback.CallbackEnv(None, {}, 0, 0, 1, None)
+    assert env.telemetry is None
+
+
+def test_telemetry_is_bit_parity_neutral():
+    """Counters/tracing must not perturb training: two identical trains
+    (one snapshotted mid-flight via a callback, one not) produce
+    bit-identical predictions."""
+    X, y = _data(n=300, seed=4)
+    p1 = lgb.train(dict(PARAMS), lgb.Dataset(X, label=y),
+                   num_boost_round=5).predict(X)
+    telemetry.reset()
+    p2 = lgb.train(dict(PARAMS), lgb.Dataset(X, label=y),
+                   num_boost_round=5).predict(X)
+    np.testing.assert_array_equal(p1, p2)
+
+
+# ------------------------------------------------------------- surfaces
+
+def test_cli_dump_telemetry_flag(tmp_path):
+    from lightgbm_tpu.cli import parse_args
+    p = parse_args(["--dump-telemetry", "/tmp/t.json", "task=train"])
+    assert p["dump_telemetry"] == "/tmp/t.json"
+    p = parse_args(["--dump-telemetry=/tmp/u.json"])
+    assert p["dump_telemetry"] == "/tmp/u.json"
+
+    # end-to-end: train task writes the snapshot JSON
+    from lightgbm_tpu import cli
+    X, y = _data(n=200, seed=5)
+    data = tmp_path / "train.csv"
+    np.savetxt(data, np.column_stack([y, X]), delimiter=",")
+    out = tmp_path / "telemetry.json"
+    model = tmp_path / "model.txt"
+    cli.main(["task=train", "data=%s" % data, "objective=binary",
+              "num_leaves=4", "num_iterations=2", "verbosity=-1",
+              "output_model=%s" % model,
+              "--dump-telemetry", str(out)])
+    snap = json.loads(out.read_text())
+    assert snap["counters"]["tree/trees"] >= 2
+
+
+# ---------------------------------------------------------------- log.py
+
+def test_log_level_default_is_process_global():
+    from lightgbm_tpu.utils import log as L
+    old = L._default_level
+    try:
+        L.Log.reset_log_level(L.Log.DEBUG)
+        seen = {}
+
+        def worker():
+            seen["level"] = L._get_level()
+
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+        # regression: thread-local default lost main-thread verbosity
+        assert seen["level"] == L.Log.DEBUG
+    finally:
+        L.Log.reset_log_level(old)
+
+
+def test_log_sink_global_with_thread_override():
+    from lightgbm_tpu.utils import log as L
+    lines, thread_lines = [], []
+
+    class _Logger:                      # register_logger wants .info()
+        def info(self, m):
+            lines.append(m)
+
+    lgb.register_logger(_Logger())
+    try:
+        L.Log.reset_log_level(L.Log.INFO)
+        L.Log.info("main")
+
+        def worker():
+            L.Log.info("inherit")                   # global sink
+            L.set_thread_log_level(L.Log.WARNING)   # per-thread override
+            L.Log.info("suppressed")
+            L.set_thread_log_level(None)
+            L.set_thread_log_sink(lambda m: thread_lines.append(m))
+            L.Log.info("threaded")
+            L.set_thread_log_sink(None, clear=True)
+
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+    finally:
+        L.Log.reset_callback(None)
+        L.Log.reset_log_level(L.Log.INFO)
+    joined = "".join(lines)
+    assert "main" in joined and "inherit" in joined
+    assert "suppressed" not in joined
+    assert "threaded" not in joined
+    assert any("threaded" in m for m in thread_lines)
+
+
+# -------------------------------------------------------------- hygiene
+
+def test_no_naked_walls():
+    """bench.py and the migrated scripts must use lightgbm_tpu.obs, never
+    raw time.time() walls (PERF.md measurement discipline)."""
+    files = ["bench.py", "scripts/profile_wall.py",
+             "scripts/resident_bisect.py", "scripts/layout_bisect.py"]
+    for rel in files:
+        text = open(os.path.join(REPO, rel)).read()
+        assert "time.time(" not in text, "%s has a naked time.time() wall" % rel
