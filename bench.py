@@ -221,6 +221,9 @@ def main():
     # full structured-counter view of the run (dataset cache traffic, fused
     # dispatch/flush, per-tree growth, auto-knob resolutions, bench walls)
     result["telemetry"] = lgb.obs.telemetry.snapshot()
+    # retrace detector verdict, hoisted for headline visibility (PERF.md
+    # per-train compile budget; per-entry detail under telemetry)
+    result["jit_compiles"] = result["telemetry"]["jit_compiles"]["total"]
     print(json.dumps(result))
 
 

@@ -21,6 +21,7 @@ from .dataset import BinnedDataset
 from .learner import (SerialTreeLearner, TreeLog, assign_leaves,
                       leaf_values_by_row)
 from .metric import Metric, create_metrics
+from .obs import track_jit
 from .objective import ObjectiveFunction, create_objective
 from .tree import Tree
 from .utils.log import Log
@@ -39,6 +40,12 @@ def _score_add(score, lv, leaf_assign, scale, class_id):
         if score.ndim > 1:
             return score.at[:, class_id].add(vals)
         return score + vals
+
+
+_score_add = track_jit("boosting/score_add", _score_add)
+# host-facing tracked alias: the learner's own (traced) assign_leaves calls
+# stay on the raw jit, so only eager-path dispatches count here
+assign_leaves = track_jit("learner/assign_leaves", assign_leaves)
 
 
 class ScoreTracker:
@@ -134,7 +141,7 @@ class GBDT:
                 return obj.get_gradients(score, it)
             return obj.get_gradients(score)
 
-        self._grad_fn = grads
+        self._grad_fn = track_jit("boosting/grads", grads)
 
     def add_valid(self, name: str, valid_set: BinnedDataset) -> None:
         vs = ScoreTracker(valid_set.num_data, self.num_tree_per_iteration,

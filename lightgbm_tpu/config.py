@@ -356,7 +356,7 @@ def _coerce(f: dataclasses.Field, value: Any) -> Any:
 
 # Alias -> canonical map. Mirrors the generated table in the reference
 # (src/io/config_auto.cpp:6-180 "parameter2aliases").
-ALIASES: Dict[str, str] = {}
+ALIASES: Dict[str, str] = {}  # graftlint: disable=module-mutable-state -- filled once at import by _alias(), read-only after
 
 
 def _alias(canonical: str, *names: str) -> None:

@@ -16,7 +16,6 @@ goss.hpp:103) using jax.random instead of the host RNG.
 """
 from __future__ import annotations
 
-import time
 from functools import partial
 from typing import NamedTuple, Optional, Tuple
 
@@ -26,7 +25,7 @@ import numpy as np
 
 from .config import Config
 from .learner import SerialTreeLearner, TreeLog, leaf_values_by_row
-from .obs import telemetry, trace_phase
+from .obs import telemetry, trace_phase, track_jit
 from .utils.timer import global_timer
 
 # Process-wide cache of jitted block functions. A Booster's jitted callables
@@ -36,7 +35,7 @@ from .utils.timer import global_timer
 # call. All data-dependent arrays are passed as jit ARGUMENTS (never closure
 # constants), so a fingerprint hit is safe across Booster instances: the
 # cached trace reads its array state from the call's operands.
-_BLOCK_CACHE: dict = {}
+_BLOCK_CACHE: dict = {}  # graftlint: disable=module-mutable-state -- cross-Booster jit cache; keyed by shape fingerprint
 _BLOCK_CACHE_MAX = 64
 
 
@@ -403,6 +402,7 @@ class FusedTrainer:
 
         if len(_BLOCK_CACHE) >= _BLOCK_CACHE_MAX:
             _BLOCK_CACHE.clear()
+        run_block = track_jit("fused/run_block", run_block)
         _BLOCK_CACHE[fp] = run_block
         return run_block
 
@@ -515,19 +515,18 @@ class FusedTrainer:
             with global_timer.timed("fused/logs_transfer"), \
                     trace_phase("lgbtpu/fused_flush"):
                 host = jax.device_get(logs)
-            t_host0 = time.perf_counter()
-            for i in range(k):
-                all_constant = True
-                for c in range(K):
-                    pick = (lambda a: a[i, c] if K > 1 else a[i])
-                    tree = self._host_tree(host, pick)
-                    tree.apply_shrinkage(float(self.config.learning_rate))
-                    trees.append(tree)
-                    if tree.num_leaves > 1:
-                        all_constant = False
-                last_iter_constant = all_constant
-            global_timer.add("fused/host_trees",
-                             time.perf_counter() - t_host0)
+            with global_timer.timed("fused/host_trees"):
+                for i in range(k):
+                    all_constant = True
+                    for c in range(K):
+                        pick = (lambda a: a[i, c] if K > 1 else a[i])
+                        tree = self._host_tree(host, pick)
+                        tree.apply_shrinkage(
+                            float(self.config.learning_rate))
+                        trees.append(tree)
+                        if tree.num_leaves > 1:
+                            all_constant = False
+                    last_iter_constant = all_constant
         except BaseException:
             self._rollback(pre_score, pre_used)
             raise

@@ -32,7 +32,7 @@ import numpy as np
 
 from .config import Config
 from .dataset import BinnedDataset
-from .obs import trace_phase
+from .obs import trace_phase, track_jit
 from .ops.histogram import build_histogram
 from .ops.split import (
     FeatureMeta,
@@ -1533,7 +1533,7 @@ class SerialTreeLearner:
             Log.fatal("use_quantized_grad requires the partitioned builder "
                       "(max_bin <= 256, tree_builder != dense)")
         self.comm = self._make_comm(comm_axis)
-        self._build = jax.jit(self.make_build_fn())
+        self._build = track_jit("learner/build", jax.jit(self.make_build_fn()))
 
     def _make_comm(self, axis: Optional[str]) -> Comm:
         return Comm(axis)

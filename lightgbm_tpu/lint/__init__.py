@@ -1,0 +1,34 @@
+"""graftlint: JAX-invariant static analysis for the LightGBM-TPU codebase.
+
+The trainer's wall-clock rests on invariants no type checker knows about:
+trusted timers only (PERF.md measurement discipline), no host-device syncs
+inside traced hot phases, explicit dtypes in the ops kernels, named
+``pallas_call``s (phase tracing), and no hidden mutable state. graftlint
+makes them checkable in tier-1, on CPU, with no TPU and no jax import.
+
+Layers:
+
+- :mod:`.core` — the framework: :class:`Finding`, :class:`Rule`, the rule
+  registry, ``# graftlint: disable=<rule>`` inline suppression, and the
+  committed ``lint_baseline.json`` (pre-existing findings are frozen; new
+  ones fail).
+- :mod:`.rules` — the rule set targeting this repo's real hazard classes.
+
+Entry points: ``scripts/lint.py`` (CLI) and :func:`run` (library/tests).
+"""
+from .core import (  # noqa: F401
+    BASELINE_NAME,
+    DEFAULT_PATHS,
+    Finding,
+    LintResult,
+    Project,
+    Rule,
+    all_rules,
+    baseline_from_findings,
+    load_baseline,
+    register,
+    run,
+    save_baseline,
+    split_new_findings,
+)
+from . import rules  # noqa: F401  (importing registers the rule set)

@@ -1,0 +1,505 @@
+"""graftlint rule set: this codebase's real hazard classes.
+
+Each rule encodes an invariant that regressed (or nearly regressed) in a
+past perf round — see ISSUE 4 / PERF.md. Rules are registered on import
+via the :func:`~.core.register` decorator; ``scripts/lint.py --list-rules``
+prints this table.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from .core import Finding, Project, Rule, SourceFile, register
+
+# ---------------------------------------------------------------------------
+# Shared AST helpers
+# ---------------------------------------------------------------------------
+
+
+def dotted(node: ast.AST) -> str:
+    """'a.b.c' for Name/Attribute chains, '' for anything dynamic."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted(node.value)
+        return base + "." + node.attr if base else node.attr
+    return ""
+
+
+def import_aliases(tree: ast.Module) -> Dict[str, str]:
+    """Local name -> canonical dotted target, from this module's imports
+    (``import numpy as np`` -> {'np': 'numpy'}; ``from time import
+    perf_counter as pc`` -> {'pc': 'time.perf_counter'})."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                out[a.asname or a.name] = node.module + "." + a.name
+    return out
+
+
+def canonical_call(node: ast.Call, aliases: Dict[str, str]) -> str:
+    """The call target's canonical dotted name with the leading import
+    alias resolved ('np.asarray' -> 'numpy.asarray')."""
+    name = dotted(node.func)
+    if not name:
+        return ""
+    head, _, rest = name.partition(".")
+    head = aliases.get(head, head)
+    return head + "." + rest if rest else head
+
+
+def _kwarg_names(node: ast.Call) -> Set[str]:
+    return {k.arg for k in node.keywords if k.arg is not None}
+
+
+# ---------------------------------------------------------------------------
+# naked-timer
+# ---------------------------------------------------------------------------
+
+_TIMER_CALLS = {"time.time", "time.perf_counter", "time.monotonic",
+                "time.process_time", "time.perf_counter_ns",
+                "time.monotonic_ns"}
+
+#: the two modules that IMPLEMENT the trusted-timing discipline
+_TIMER_IMPL = {"lightgbm_tpu/obs.py", "lightgbm_tpu/utils/timer.py"}
+
+
+@register
+class NakedTimerRule(Rule):
+    """PERF.md measurement discipline: wall clocks must come from
+    ``lightgbm_tpu.obs`` (``wall``/``timed_sync`` end in a forced
+    1-element transfer; ``block_until_ready`` and bare ``perf_counter``
+    pairs do not reliably synchronize through the tunnel)."""
+
+    id = "naked-timer"
+    description = ("raw time.time()/perf_counter() wall outside obs.py/"
+                   "utils/timer.py; use obs.wall/obs.timed_sync/obs.sync")
+
+    def check_file(self, f: SourceFile) -> Iterator[Finding]:
+        if f.rel in _TIMER_IMPL:
+            return
+        aliases = import_aliases(f.tree)
+        for node in ast.walk(f.tree):
+            if isinstance(node, ast.Call) \
+                    and canonical_call(node, aliases) in _TIMER_CALLS:
+                yield f.finding(node, self.id,
+                                "naked wall-clock timer %s(); use "
+                                "lightgbm_tpu.obs (wall/timed_sync/sync)"
+                                % dotted(node.func))
+
+
+# ---------------------------------------------------------------------------
+# host-sync (cross-file: call graph over the traced hot modules)
+# ---------------------------------------------------------------------------
+
+_HOT_FILES = ("lightgbm_tpu/learner.py", "lightgbm_tpu/fused.py")
+_HOT_DIR = "lightgbm_tpu/ops/"
+
+_SYNC_ATTR_CALLS = {"item", "tolist", "block_until_ready"}
+_SYNC_DOTTED = {"numpy.asarray", "numpy.array", "numpy.ascontiguousarray",
+                "jax.device_get"}
+_SYNC_BUILTINS = {"float", "int"}
+
+_JIT_HEADS = {"jax.jit", "jit"}
+_PARTIAL_HEADS = {"partial", "functools.partial", "_partial"}
+
+
+class _FnInfo:
+    __slots__ = ("node", "file", "qual", "parent", "is_method", "children",
+                 "hot", "edges")
+
+    def __init__(self, node, file: SourceFile, qual: str,
+                 parent: Optional["_FnInfo"], is_method: bool) -> None:
+        self.node = node
+        self.file = file
+        self.qual = qual
+        self.parent = parent
+        self.is_method = is_method
+        self.children: Dict[str, List["_FnInfo"]] = {}
+        self.hot = False
+        self.edges: List["_FnInfo"] = []
+
+
+def _is_jit_decorator(dec: ast.AST) -> bool:
+    name = dotted(dec)
+    if name in _JIT_HEADS or name.endswith(".jit"):
+        return True
+    if isinstance(dec, ast.Call):
+        fname = dotted(dec.func)
+        if fname in _JIT_HEADS or fname.endswith(".jit"):
+            return True
+        if fname in _PARTIAL_HEADS or fname.endswith(".partial"):
+            return any(dotted(a) in _JIT_HEADS or dotted(a).endswith(".jit")
+                       for a in dec.args)
+    return False
+
+
+def _own_walk(node) -> Iterator[ast.AST]:
+    """Walk a function's (or module's) OWN statements, not descending into
+    nested function/class definitions."""
+    stack: List[ast.AST] = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _call_name_args(node: ast.Call) -> Iterator[ast.Name]:
+    for a in list(node.args) + [k.value for k in node.keywords]:
+        if isinstance(a, ast.Name):
+            yield a
+
+
+@register
+class HostSyncRule(Rule):
+    """No host-device syncs inside functions reachable from the traced hot
+    phases (the round-5 dispatch-soup class: one stray ``.item()`` or
+    ``np.asarray`` in the per-split loop serializes the pipeline).
+
+    Reachability is a lexically-scoped call graph over learner.py,
+    fused.py and ops/: entries are jit-decorated functions and functions
+    wrapped by value in ``jax.jit``/``partial`` (the learner hands
+    ``partial(build_tree*, ...)`` to jit); edges follow bare-name calls
+    (resolved innermost-scope-first, never to methods), ``x.attr(...)``
+    calls (resolved to methods only), function-valued arguments (covers
+    ``lax.while_loop``/``scan``/``vmap`` bodies), and nested defs of hot
+    functions. ``float()``/``int()`` are flagged only when the argument
+    visibly involves a jax/jnp call — static config scalars stay legal."""
+
+    id = "host-sync"
+    description = (".item()/float()/np.asarray/block_until_ready inside "
+                   "functions reachable from jit-traced hot phases")
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        hot_files = [f for f in project.files
+                     if f.tree is not None
+                     and (f.rel in _HOT_FILES or f.rel.startswith(_HOT_DIR))]
+        if not hot_files:
+            return
+        infos: List[_FnInfo] = []
+        methods: Dict[str, List[_FnInfo]] = {}
+        top_level: Dict[str, Dict[str, List[_FnInfo]]] = {}  # rel -> name -> fns
+
+        # pass 1: collect functions with their lexical position
+        for f in hot_files:
+            top_level[f.rel] = {}
+            stack: List[Tuple[ast.AST, str, Optional[_FnInfo], bool]] = \
+                [(f.tree, "", None, False)]
+            while stack:
+                parent, prefix, encl, in_class = stack.pop()
+                for node in ast.iter_child_nodes(parent):
+                    if isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        info = _FnInfo(node, f, prefix + node.name, encl,
+                                       in_class)
+                        infos.append(info)
+                        if in_class:
+                            methods.setdefault(node.name, []).append(info)
+                        elif encl is None:
+                            top_level[f.rel].setdefault(
+                                node.name, []).append(info)
+                        else:
+                            encl.children.setdefault(
+                                node.name, []).append(info)
+                        stack.append((node, info.qual + ".", info, False))
+                    elif isinstance(node, ast.ClassDef):
+                        stack.append((node, prefix + node.name + ".",
+                                      encl, True))
+                    else:
+                        stack.append((node, prefix, encl, in_class))
+
+        def resolve_bare(ctx: Optional[_FnInfo], rel: str, name: str
+                         ) -> List[_FnInfo]:
+            cur = ctx
+            while cur is not None:
+                if name in cur.children:
+                    return cur.children[name]
+                cur = cur.parent
+            if name in top_level.get(rel, {}):
+                return top_level[rel][name]
+            out = []
+            for tl in top_level.values():
+                out.extend(tl.get(name, []))
+            return out
+
+        # pass 2: entries (decorators + jit/partial by value) and edges
+        entries: List[_FnInfo] = []
+        for info in infos:
+            if any(_is_jit_decorator(d) for d in info.node.decorator_list):
+                entries.append(info)
+
+        alias_cache: Dict[str, Dict[str, str]] = {}
+
+        def scan_calls(owner: Optional[_FnInfo], f: SourceFile, body):
+            rel = f.rel
+            if rel not in alias_cache:
+                alias_cache[rel] = import_aliases(f.tree)
+            aliases = alias_cache[rel]
+            for node in _own_walk(body):
+                if not isinstance(node, ast.Call):
+                    continue
+                cname = canonical_call(node, aliases)
+                wraps = (cname in _JIT_HEADS or cname.endswith(".jit")
+                         or cname in _PARTIAL_HEADS)
+                for a in _call_name_args(node):
+                    for target in resolve_bare(owner, rel, a.id):
+                        if wraps:
+                            entries.append(target)
+                        elif owner is not None:
+                            owner.edges.append(target)
+                if owner is None:
+                    continue
+                fn = node.func
+                if isinstance(fn, ast.Name):
+                    owner.edges.extend(resolve_bare(owner, rel, fn.id))
+                elif isinstance(fn, ast.Attribute):
+                    owner.edges.extend(methods.get(fn.attr, []))
+
+        for f in hot_files:
+            scan_calls(None, f, f.tree)
+        for info in infos:
+            scan_calls(info, info.file, info.node)
+
+        # pass 3: propagate hotness (nested defs trace with their parent)
+        work = list(entries)
+        for info in work:
+            info.hot = True
+        while work:
+            cur = work.pop()
+            for group in cur.children.values():
+                cur.edges.extend(group)
+            for nxt in cur.edges:
+                if not nxt.hot:
+                    nxt.hot = True
+                    work.append(nxt)
+
+        # pass 4: scan hot bodies (own statements only; nested defs are
+        # scanned as their own hot entries)
+        for info in infos:
+            if not info.hot:
+                continue
+            if info.file.rel not in alias_cache:
+                alias_cache[info.file.rel] = import_aliases(info.file.tree)
+            aliases = alias_cache[info.file.rel]
+            for node in _own_walk(info.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                hit = self._sync_kind(node, aliases)
+                if hit:
+                    yield info.file.finding(
+                        node, self.id,
+                        "%s in '%s', reachable from a jit-traced hot "
+                        "phase (forces a host-device sync)"
+                        % (hit, info.qual))
+
+    @staticmethod
+    def _arg_is_arrayish(node: ast.AST, aliases: Dict[str, str]) -> bool:
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call):
+                head = canonical_call(n, aliases).split(".")[0]
+                if head in ("jax", "jnp") or aliases.get(head) == "jax.numpy":
+                    return True
+        return False
+
+    @classmethod
+    def _sync_kind(cls, node: ast.Call,
+                   aliases: Dict[str, str]) -> Optional[str]:
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr in _SYNC_ATTR_CALLS \
+                and not node.args and not node.keywords:
+            return ".%s()" % fn.attr
+        cname = canonical_call(node, aliases)
+        if cname in _SYNC_DOTTED:
+            return "%s()" % dotted(node.func)
+        if cname in _SYNC_BUILTINS and node.args \
+                and cls._arg_is_arrayish(node.args[0], aliases):
+            return "%s() conversion" % cname
+        return None
+
+
+# ---------------------------------------------------------------------------
+# implicit-dtype
+# ---------------------------------------------------------------------------
+
+#: constructor -> index of the positional dtype parameter
+_DTYPE_POS = {"zeros": 1, "ones": 1, "empty": 1, "full": 2, "arange": 3,
+              "asarray": 1}
+_JNP_HEADS = {"jax.numpy", "jnp"}
+
+
+@register
+class ImplicitDtypeRule(Rule):
+    """ops/ kernels must spell dtypes out: implicit f32/i32 promotion
+    changed bit patterns across jax versions and hid u8-vs-i32 traffic
+    regressions; golden/consistency tests pin the explicit choice."""
+
+    id = "implicit-dtype"
+    description = ("jnp.zeros/ones/empty/full/arange/asarray without an "
+                   "explicit dtype in lightgbm_tpu/ops/ kernels")
+
+    def check_file(self, f: SourceFile) -> Iterator[Finding]:
+        if not f.rel.startswith("lightgbm_tpu/ops/"):
+            return
+        aliases = import_aliases(f.tree)
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            cname = canonical_call(node, aliases)
+            head, _, tail = cname.rpartition(".")
+            if head not in _JNP_HEADS and aliases.get(head, head) != "jax.numpy":
+                continue
+            pos = _DTYPE_POS.get(tail)
+            if pos is None:
+                continue
+            if "dtype" in _kwarg_names(node) or len(node.args) > pos:
+                continue
+            yield f.finding(node, self.id,
+                            "%s without an explicit dtype" % dotted(node.func))
+
+
+# ---------------------------------------------------------------------------
+# unnamed-pallas-call
+# ---------------------------------------------------------------------------
+
+@register
+class UnnamedPallasCallRule(Rule):
+    """``pallas_call`` without ``name=`` drops the kernel's identity from
+    profiler timelines and HLO dumps — PR 3's phase tracing (and every
+    trace-driven bisect script) keys on those names."""
+
+    id = "unnamed-pallas-call"
+    description = "pallas_call without a name= (breaks phase tracing)"
+
+    def check_file(self, f: SourceFile) -> Iterator[Finding]:
+        for node in ast.walk(f.tree):
+            if isinstance(node, ast.Call) \
+                    and dotted(node.func).rsplit(".", 1)[-1] == "pallas_call" \
+                    and "name" not in _kwarg_names(node):
+                yield f.finding(node, self.id,
+                                "pallas_call without name= (kernel is "
+                                "anonymous in traces and HLO dumps)")
+
+
+# ---------------------------------------------------------------------------
+# mutable-default
+# ---------------------------------------------------------------------------
+
+def _is_mutable_literal(node: ast.AST) -> bool:
+    if isinstance(node, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                         ast.DictComp, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call) and not node.args and not node.keywords:
+        return dotted(node.func) in {"list", "dict", "set", "bytearray",
+                                     "defaultdict", "collections.defaultdict"}
+    return False
+
+
+@register
+class MutableDefaultRule(Rule):
+    """Mutable default arguments are shared across calls — with cached
+    jitted callables (``_BLOCK_CACHE``) a leaked default outlives the
+    Booster that wrote it."""
+
+    id = "mutable-default"
+    description = "mutable default argument (list/dict/set literal)"
+
+    def check_file(self, f: SourceFile) -> Iterator[Finding]:
+        for node in ast.walk(f.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                args = node.args
+                for d in list(args.defaults) + [
+                        d for d in args.kw_defaults if d is not None]:
+                    if _is_mutable_literal(d):
+                        yield f.finding(
+                            d, self.id,
+                            "mutable default argument in '%s'"
+                            % getattr(node, "name", "<lambda>"))
+
+
+# ---------------------------------------------------------------------------
+# module-mutable-state
+# ---------------------------------------------------------------------------
+
+_MUTATOR_METHODS = {"append", "add", "update", "setdefault", "pop",
+                    "popitem", "clear", "extend", "insert", "remove",
+                    "discard"}
+
+
+@register
+class ModuleMutableStateRule(Rule):
+    """Module-level mutable state written from function scope is a hidden
+    process-global — telemetry belongs in the ``obs`` registry (locked,
+    snapshot-able, reset-able), not in ad-hoc module dicts. Deliberate
+    caches carry an inline disable naming their invariant."""
+
+    id = "module-mutable-state"
+    description = ("module-level mutable literal written from function "
+                   "scope outside the obs registry")
+
+    def check_file(self, f: SourceFile) -> Iterator[Finding]:
+        if f.rel == "lightgbm_tpu/obs.py":
+            return
+        decls: Dict[str, ast.stmt] = {}
+        for node in f.tree.body:
+            target = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                target = node.targets[0].id
+                value = node.value
+            elif isinstance(node, ast.AnnAssign) \
+                    and isinstance(node.target, ast.Name) \
+                    and node.value is not None:
+                target = node.target.id
+                value = node.value
+            if target and _is_mutable_literal(value):
+                decls[target] = node
+        if not decls:
+            return
+        writes: Dict[str, Tuple[int, str]] = {}
+
+        def visit_fn(fn_node):
+            for node in ast.walk(fn_node):
+                name, how = None, ""
+                if isinstance(node, (ast.Assign, ast.AugAssign)):
+                    targets = node.targets if isinstance(node, ast.Assign) \
+                        else [node.target]
+                    for t in targets:
+                        if isinstance(t, ast.Subscript) \
+                                and isinstance(t.value, ast.Name) \
+                                and t.value.id in decls:
+                            name, how = t.value.id, "subscript write"
+                elif isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute) \
+                        and isinstance(node.func.value, ast.Name) \
+                        and node.func.value.id in decls \
+                        and node.func.attr in _MUTATOR_METHODS:
+                    name, how = node.func.value.id, \
+                        ".%s()" % node.func.attr
+                elif isinstance(node, ast.Global):
+                    for n in node.names:
+                        if n in decls:
+                            name, how = n, "global rebind"
+                if name and name not in writes:
+                    writes[name] = (node.lineno, how)
+
+        for node in ast.walk(f.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                visit_fn(node)
+        for name, decl in decls.items():
+            if name in writes:
+                line, how = writes[name]
+                yield f.finding(
+                    decl, self.id,
+                    "module-level mutable '%s' written from function scope "
+                    "(%s at line %d); use the obs registry or justify with "
+                    "an inline disable" % (name, how, line))

@@ -156,6 +156,99 @@ def trace_phase(name: str) -> Iterator[None]:
 
 
 # ---------------------------------------------------------------------------
+# Retrace / compile-budget detection
+# ---------------------------------------------------------------------------
+#
+# Every jit entry point of the training path is wrapped in track_jit(), so
+# each (re)trace shows up as a named counter in the telemetry registry:
+# ``jit/compiles/<name>``. A retrace explosion (the round-5 "dispatch soup"
+# failure class) then reads directly off ``Booster.telemetry()`` /
+# ``bench.py`` JSON instead of being inferred from wall-clock, and
+# tests/test_retrace.py pins a per-train compile budget.
+
+_JIT_COMPILES_PREFIX = "jit/compiles/"
+_BACKEND_COMPILES = "jit/backend_compiles"
+_compile_listener_installed = False
+
+
+def install_compile_listener() -> None:
+    """Count every XLA backend compile into ``jit/backend_compiles``.
+
+    Uses jax.monitoring's duration listener (fires once per
+    ``backend_compile`` event, including jits we did not wrap). Idempotent;
+    a jax without the monitoring API degrades to a no-op."""
+    global _compile_listener_installed
+    if _compile_listener_installed:
+        return
+    _compile_listener_installed = True
+    try:
+        from jax import monitoring
+
+        def _on_event(event: str, duration: float, **kw) -> None:
+            if "backend_compile" in event:
+                telemetry.count(_BACKEND_COMPILES)
+                telemetry.add_time("jit/backend_compile_s", duration)
+
+        monitoring.register_event_duration_secs_listener(_on_event)
+    except Exception:  # pragma: no cover - older jax without monitoring
+        pass
+
+
+class _TrackedJit:
+    """Transparent wrapper over a jitted callable that turns compiled-cache
+    growth into telemetry counts.
+
+    ``fn._cache_size()`` (PjitFunction) counts cached executables — one per
+    traced signature — so a positive delta across a call means that call
+    paid a trace+compile. Attribute access (``.lower()``, ``.trace()``,
+    static-argname metadata) delegates to the wrapped function."""
+
+    __slots__ = ("_fn", "_name", "_seen")
+
+    def __init__(self, name: str, fn: Callable[..., Any]) -> None:
+        self._fn = fn
+        self._name = name
+        self._seen = self._size() or 0
+
+    def _size(self) -> Optional[int]:
+        try:
+            return self._fn._cache_size()
+        except Exception:  # pragma: no cover - non-pjit callable
+            return None
+
+    def __call__(self, *args, **kwargs):
+        out = self._fn(*args, **kwargs)
+        size = self._size()
+        if size is not None:
+            if size > self._seen:
+                telemetry.count(_JIT_COMPILES_PREFIX + self._name,
+                                size - self._seen)
+            self._seen = size  # shrink = cache cleared; re-arm
+        return out
+
+    def __getattr__(self, name: str):
+        return getattr(self._fn, name)
+
+
+def track_jit(name: str, fn: Callable[..., Any]) -> Callable[..., Any]:
+    """Wrap a jitted callable so its (re)traces count into
+    ``jit/compiles/<name>``. Installs the global backend-compile listener
+    on first use. Wrapping an already-tracked callable re-labels it."""
+    install_compile_listener()
+    if isinstance(fn, _TrackedJit):
+        fn = fn._fn
+    return _TrackedJit(name, fn)
+
+
+def jit_compiles() -> Dict[str, int]:
+    """Per-entry-point compile counts seen so far (name -> count)."""
+    with telemetry._lock:
+        return {k[len(_JIT_COMPILES_PREFIX):]: v
+                for k, v in telemetry._counters.items()
+                if k.startswith(_JIT_COMPILES_PREFIX)}
+
+
+# ---------------------------------------------------------------------------
 # Structured run counters
 # ---------------------------------------------------------------------------
 
@@ -246,11 +339,20 @@ class Telemetry:
         with self._lock:
             timers = {k: round(v, 6) for k, v in self._timers.items()}
             calls = dict(self._timer_calls)
+            per_fn = {k[len(_JIT_COMPILES_PREFIX):]: v
+                      for k, v in self._counters.items()
+                      if k.startswith(_JIT_COMPILES_PREFIX)}
             snap = {
                 "counters": dict(self._counters),
                 "gauges": dict(self._gauges),
                 "timers": timers,
                 "timer_calls": calls,
+                "jit_compiles": {
+                    "per_function": per_fn,
+                    "total": sum(per_fn.values()),
+                    "backend_compiles":
+                        self._counters.get(_BACKEND_COMPILES, 0),
+                },
                 "records": {k: [dict(r) for r in v]
                             for k, v in self._records.items()},
             }

@@ -29,6 +29,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs import track_jit
+
 try:  # pallas is optional at import time (CPU test meshes use XLA paths)
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -119,6 +121,9 @@ def build_histogram_np(bins: np.ndarray, ghc: np.ndarray, num_bins: int) -> np.n
 def build_histogram_jit(bins, ghc, num_bins: int, chunk: int = DEFAULT_CHUNK,
                         mxu_bf16: bool = False):
     return build_histogram(bins, ghc, num_bins, chunk, mxu_bf16)
+
+
+build_histogram_jit = track_jit("ops/build_histogram", build_histogram_jit)
 
 
 # ---------------------------------------------------------------------------
@@ -420,6 +425,7 @@ def hist_pallas_segment(work: jax.Array, plane, start, cnt, *,
                          cnt.astype(jnp.int32)])
     work_out, acc = pl.pallas_call(
         kern,
+        name="hist_pallas_segment",
         grid_spec=grid_spec,
         out_shape=[jax.ShapeDtypeStruct(work.shape, work.dtype),
                    jax.ShapeDtypeStruct((f * sh, lo_w * nch), jnp.float32)],
@@ -736,6 +742,7 @@ def hist_pallas_segment_planes(work: jax.Array, plane, start, cnt, *,
                          cnt.astype(jnp.int32)])
     work_out, acc = pl.pallas_call(
         kern,
+        name="hist_pallas_segment_planes",
         grid_spec=grid_spec,
         out_shape=[jax.ShapeDtypeStruct(work.shape, work.dtype),
                    jax.ShapeDtypeStruct((f * sh, lo_w * nch), jnp.float32)],

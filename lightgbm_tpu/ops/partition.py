@@ -920,6 +920,7 @@ def partition_segment_fused(
     )
     work_out, lt = pl.pallas_call(
         kern,
+        name="partition_segment_fused",
         grid_spec=grid_spec,
         out_shape=[jax.ShapeDtypeStruct(work.shape, work.dtype),
                    jax.ShapeDtypeStruct((1,), jnp.int32)],
@@ -1264,6 +1265,7 @@ def partition_segment_planes_fused(
     )
     work_out, lt = pl.pallas_call(
         kern,
+        name="partition_segment_planes_fused",
         grid_spec=grid_spec,
         out_shape=[jax.ShapeDtypeStruct(work.shape, work.dtype),
                    jax.ShapeDtypeStruct((1,), jnp.int32)],

@@ -28,6 +28,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs import track_jit
+
 K_ZERO = 1e-35
 
 
@@ -87,13 +89,15 @@ def pack_splits(trees: List, num_class: int = 1) -> PackedSplits:
         for rr, cats in a["cat_values"].items():
             cat_values[ti, rr, :len(cats)] = cats
     pk = PackedSplits(
-        slot=jnp.asarray(slot), feature=jnp.asarray(feature),
-        threshold=jnp.asarray(threshold), kind=jnp.asarray(kind),
-        default_left=jnp.asarray(default_left),
-        missing_type=jnp.asarray(missing_type),
-        num_splits=jnp.asarray(num_splits),
-        value_of_slot=jnp.asarray(value_of_slot),
-        tree_class=jnp.asarray(tree_class),
+        slot=jnp.asarray(slot, jnp.int32),
+        feature=jnp.asarray(feature, jnp.int32),
+        threshold=jnp.asarray(threshold, jnp.float32),
+        kind=jnp.asarray(kind, jnp.int32),
+        default_left=jnp.asarray(default_left, jnp.bool_),
+        missing_type=jnp.asarray(missing_type, jnp.int32),
+        num_splits=jnp.asarray(num_splits, jnp.int32),
+        value_of_slot=jnp.asarray(value_of_slot, jnp.float32),
+        tree_class=jnp.asarray(tree_class, jnp.int32),
         cat_values=jnp.asarray(cat_values, jnp.int32))
     return pk, has_cat
 
@@ -149,7 +153,8 @@ def predict_raw(X: jax.Array, pack: PackedSplits, *, num_class: int = 1,
         # unsplit and padding trees both carry all-zero slot values
         if num_class > 1:
             cls_oh = (tb.tree_class[:, None]
-                      == jnp.arange(num_class)[None, :]).astype(jnp.float32)
+                      == jnp.arange(num_class, dtype=jnp.int32)[None, :]
+                      ).astype(jnp.float32)
             score = score + vals.T @ cls_oh
         else:
             score = score + jnp.sum(vals, axis=0)
@@ -161,6 +166,9 @@ def predict_raw(X: jax.Array, pack: PackedSplits, *, num_class: int = 1,
         score0 = score0 + init_score
     score, _ = jax.lax.scan(one_batch, score0, grouped)
     return score
+
+
+predict_raw = track_jit("ops/predict_raw", predict_raw)
 
 
 def tree_to_bin_log(tree, dataset):
@@ -224,18 +232,18 @@ def tree_to_bin_log(tree, dataset):
         if r else tree.leaf_value[:1]
     return TreeLog(
         num_splits=jnp.int32(r),
-        split_leaf=jnp.asarray(slot),
-        feature=jnp.asarray(feature),
-        bin=jnp.asarray(tbin),
-        kind=jnp.asarray(kind),
-        default_left=jnp.asarray(default_left),
+        split_leaf=jnp.asarray(slot, jnp.int32),
+        feature=jnp.asarray(feature, jnp.int32),
+        bin=jnp.asarray(tbin, jnp.int32),
+        kind=jnp.asarray(kind, jnp.int32),
+        default_left=jnp.asarray(default_left, jnp.bool_),
         gain=jnp.zeros(rp, jnp.float32),
         left_sum=jnp.zeros((rp, 3), jnp.float32),
         right_sum=jnp.zeros((rp, 3), jnp.float32),
-        go_left=jnp.asarray(go_left),
-        miss_bin=jnp.asarray(miss_bin),
-        movable=jnp.asarray(movable),
-        leaf_value=jnp.asarray(leaf_value),
+        go_left=jnp.asarray(go_left, jnp.bool_),
+        miss_bin=jnp.asarray(miss_bin, jnp.int32),
+        movable=jnp.asarray(movable, jnp.bool_),
+        leaf_value=jnp.asarray(leaf_value, jnp.float32),
         leaf_sum=jnp.zeros((rp + 1, 3), jnp.float32),
         row_leaf=jnp.zeros(1, jnp.int32),
     )

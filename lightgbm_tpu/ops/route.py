@@ -112,6 +112,7 @@ def route_rows(bins_t: jax.Array, table: jax.Array, num_splits: jax.Array,
     )
     out = pl.pallas_call(
         kern,
+        name="route_rows",
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((nsub, 128), jnp.int32),
         compiler_params=pltpu.CompilerParams(

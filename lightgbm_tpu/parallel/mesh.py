@@ -39,6 +39,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..config import Config
 from ..dataset import BinnedDataset
 from ..learner import Comm, SerialTreeLearner, TreeLog
+from ..obs import track_jit
 from ..utils.log import Log
 
 DATA_AXIS = "data"
@@ -135,7 +136,7 @@ class _MeshTreeLearner(SerialTreeLearner):
             in_specs=(data_spec, data_spec, P(), P(), P(), P()),
             out_specs=_tree_log_specs(row_spec),
         )
-        self._build = jax.jit(sharded)
+        self._build = track_jit("mesh/build", jax.jit(sharded))
 
     def _make_comm(self, axis: Optional[str]) -> Comm:
         return Comm(axis, mode=self.comm_mode,

@@ -7,7 +7,6 @@ fixed overhead: per-op = (t_K - t_1) / (K - 1).
 """
 import os
 import sys
-import time
 from functools import partial
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -16,15 +15,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from lightgbm_tpu import obs
+
 
 def timed(fn, *args):
     """Run once (compiled), sync via scalar transfer, return seconds."""
-    r = fn(*args)
-    leaf = jax.tree.leaves(r)[0]
-    t0 = time.perf_counter()
-    r = fn(*args)
-    _ = float(jnp.asarray(jax.tree.leaves(r)[0]).ravel()[0])
-    return time.perf_counter() - t0
+    fn(*args)
+    return obs.timed_sync(lambda: fn(*args))
 
 
 def chain_cost(make_chain, K=8):

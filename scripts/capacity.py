@@ -8,7 +8,6 @@ Usage: python scripts/capacity.py [rows]   (default 30M)
 """
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -24,19 +23,20 @@ def main():
     import lightgbm_tpu as lgb
     from bench import make_higgs_like
 
-    t0 = time.time()
-    X, y = make_higgs_like(BIG_N)
-    print("datagen %.1fs" % (time.time() - t0), flush=True)
-    t0 = time.time()
-    ds = lgb.Dataset(X, label=y)
-    ds.construct()
-    print("construct %.1fs" % (time.time() - t0), flush=True)
+    from lightgbm_tpu import obs
+    with obs.wall("capacity/datagen", record=False) as w:
+        X, y = make_higgs_like(BIG_N)
+    print("datagen %.1fs" % w.seconds, flush=True)
+    with obs.wall("capacity/construct", record=False) as w:
+        ds = lgb.Dataset(X, label=y)
+        ds.construct()
+    print("construct %.1fs" % w.seconds, flush=True)
     params = {"objective": "binary", "num_leaves": 255, "max_bin": 255,
               "learning_rate": 0.1, "verbosity": -1, "metric": ["auc"],
               "tpu_iter_block": 5}
-    t0 = time.time()
-    bst = lgb.train(dict(params), ds, num_boost_round=10)
-    train_s = time.time() - t0
+    with obs.wall("capacity/train", record=False) as w:
+        bst = lgb.train(dict(params), ds, num_boost_round=10)
+    train_s = w.seconds
     (_, _, auc, _), = bst.eval_train()
     stats = {}
     try:

@@ -6,7 +6,6 @@ import json
 import os
 import shutil
 import sys
-import time
 from functools import partial
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -143,7 +142,7 @@ def profile(variant):
     def chain(work):
         def body(i, acc):
             a, = pl.pallas_call(
-                kern, grid_spec=grid_spec,
+                kern, name="hist_bisect", grid_spec=grid_spec,
                 out_shape=[jax.ShapeDtypeStruct((F * SH, LO_W * NCH),
                                                 jnp.float32)],
                 compiler_params=pltpu.CompilerParams(

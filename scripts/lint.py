@@ -1,0 +1,88 @@
+"""graftlint CLI: JAX-invariant static analysis over the repo.
+
+Usage:
+    python scripts/lint.py                      # lint default paths, human output
+    python scripts/lint.py --json               # machine-readable findings
+    python scripts/lint.py --update-baseline    # freeze current findings
+    python scripts/lint.py --no-baseline        # show ALL findings
+    python scripts/lint.py --list-rules         # rule table
+    python scripts/lint.py lightgbm_tpu/ops     # restrict paths
+
+Exit status: 0 when every finding is baselined or suppressed, 1 otherwise.
+Pure stdlib — no jax import; a full-repo run stays well under the tier-1
+~5 s budget (tests/test_lint.py enforces it).
+"""
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from lightgbm_tpu import lint  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graftlint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("paths", nargs="*", default=list(lint.DEFAULT_PATHS),
+                    help="files/dirs to lint (default: %s)"
+                         % " ".join(lint.DEFAULT_PATHS))
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit one JSON object with findings + summary")
+    ap.add_argument("--baseline", default=os.path.join(REPO,
+                                                       lint.BASELINE_NAME),
+                    help="baseline file (default: repo lint_baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline; report every finding")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline to the current findings "
+                         "and exit 0")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule ids to run (default: all)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the registered rules and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rid, rule in sorted(lint.all_rules().items()):
+            print("%-22s %s" % (rid, rule.description))
+        return 0
+
+    rules = [r.strip() for r in args.rules.split(",")] if args.rules else None
+    result = lint.run(REPO, args.paths, rules=rules)
+
+    if args.update_baseline:
+        lint.save_baseline(args.baseline,
+                           lint.baseline_from_findings(result.findings))
+        print("baseline updated: %s (%d findings frozen)"
+              % (os.path.relpath(args.baseline, REPO), len(result.findings)))
+        return 0
+
+    if args.no_baseline:
+        new, old = list(result.findings), []
+    else:
+        baseline = lint.load_baseline(args.baseline)
+        new, old = lint.split_new_findings(result.findings, baseline)
+
+    if args.as_json:
+        print(json.dumps({
+            "new": [vars(f) for f in new],
+            "baselined": [vars(f) for f in old],
+            "suppressed": [vars(f) for f in result.suppressed],
+            "files": len(result.project.files),
+            "ok": not new,
+        }))
+    else:
+        for f in new:
+            print(f.render())
+        print("graftlint: %d file(s), %d new finding(s), %d baselined, "
+              "%d suppressed" % (len(result.project.files), len(new),
+                                 len(old), len(result.suppressed)))
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

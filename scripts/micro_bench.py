@@ -10,7 +10,6 @@ Measures the primitives the partitioned learner is built from:
 """
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -18,16 +17,20 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from lightgbm_tpu import obs
+
 
 def timeit(fn, *args, iters=5, warmup=2):
     for _ in range(warmup):
         r = fn(*args)
-    jax.block_until_ready(r)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        r = fn(*args)
-    jax.block_until_ready(r)
-    return (time.perf_counter() - t0) / iters
+    obs.sync(r)
+    # trusted wall per PERF.md discipline: the timed block ends with a
+    # forced 1-element transfer of the last result
+    with obs.wall("micro_bench", record=False) as w:
+        for _ in range(iters):
+            r = fn(*args)
+        obs.sync(r)
+    return w.seconds / iters
 
 
 def main():

@@ -1,12 +1,13 @@
 """Measure bare pallas_call launch overhead: trivial kernel chained 254x."""
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
+
+from lightgbm_tpu import obs
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -24,18 +25,19 @@ def chain(x):
     def body(i, x):
         return pl.pallas_call(
             kern,
+            name="launch_probe",
             out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
         )(x)
     return jax.lax.fori_loop(0, REPS, body, x)
 
 
 x = jnp.zeros((256, 128), jnp.float32)
-jax.block_until_ready(chain(x))
+obs.sync(chain(x))
 best = 1e9
 for _ in range(3):
-    t0 = time.perf_counter()
-    jax.block_until_ready(chain(x))
-    best = min(best, time.perf_counter() - t0)
+    with obs.wall("pallas_launch/trivial", record=False) as w:
+        obs.sync(chain(x))
+    best = min(best, w.seconds)
 print("trivial pallas: %.1f us/call" % (best / REPS * 1e6))
 
 
@@ -47,12 +49,12 @@ def chain_xla(x):
     return jax.lax.fori_loop(0, REPS, body, x)
 
 
-jax.block_until_ready(chain_xla(x))
+obs.sync(chain_xla(x))
 best = 1e9
 for _ in range(3):
-    t0 = time.perf_counter()
-    jax.block_until_ready(chain_xla(x))
-    best = min(best, time.perf_counter() - t0)
+    with obs.wall("pallas_launch/xla", record=False) as w:
+        obs.sync(chain_xla(x))
+    best = min(best, w.seconds)
 print("plain XLA add: %.1f us/call" % (best / REPS * 1e6))
 
 # trivial kernel with HBM work buffer + aliasing + scalar prefetch,
@@ -83,6 +85,7 @@ def chain2(work):
         )
         w2, o = pl.pallas_call(
             kern2,
+            name="launch_probe_grid",
             grid_spec=grid_spec,
             out_shape=[jax.ShapeDtypeStruct(work.shape, work.dtype),
                        jax.ShapeDtypeStruct((256, 128), jnp.uint8)],
@@ -92,10 +95,10 @@ def chain2(work):
     return jax.lax.fori_loop(0, REPS, body, (work, jnp.int32(0)))
 
 
-jax.block_until_ready(chain2(work))
+obs.sync(chain2(work))
 best = 1e9
 for _ in range(3):
-    t0 = time.perf_counter()
-    jax.block_until_ready(chain2(work))
-    best = min(best, time.perf_counter() - t0)
+    with obs.wall("pallas_launch/hbm_alias", record=False) as w:
+        obs.sync(chain2(work))
+    best = min(best, w.seconds)
 print("HBM+alias pallas: %.1f us/call" % (best / REPS * 1e6))

@@ -1,7 +1,6 @@
 """Bisect the partition kernel's ~400us fixed cost: strip pieces, measure."""
 import os
 import sys
-import time
 from functools import partial
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -9,6 +8,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from lightgbm_tpu import obs
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -144,7 +145,7 @@ def bench(variant):
             scalars = jnp.stack([jax.lax.rem(i, 2), jnp.int32(CH),
                                  cnt, jax.lax.rem(i, 28)])
             w2, lt = pl.pallas_call(
-                kern, grid_spec=grid_spec,
+                kern, name="part_bisect", grid_spec=grid_spec,
                 out_shape=[jax.ShapeDtypeStruct(work.shape, work.dtype),
                            jax.ShapeDtypeStruct((1,), jnp.int32)],
                 input_output_aliases={1: 0},
@@ -156,13 +157,12 @@ def bench(variant):
         return jax.lax.fori_loop(0, REPS, body, (work, jnp.int32(0)))
 
     for cnt in (256, 16384):
-        out = chain(work, jnp.int32(cnt))
-        jax.block_until_ready(out)
+        obs.sync(chain(work, jnp.int32(cnt)))
         best = 1e9
         for _ in range(2):
-            t0 = time.perf_counter()
-            jax.block_until_ready(chain(work, jnp.int32(cnt)))
-            best = min(best, time.perf_counter() - t0)
+            with obs.wall("part_bisect/variant", record=False) as w:
+                obs.sync(chain(work, jnp.int32(cnt)))
+            best = min(best, w.seconds)
         print("variant=%d cnt=%6d: %7.1f us/call" %
               (variant, cnt, best / REPS * 1e6))
 

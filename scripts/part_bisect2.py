@@ -4,7 +4,6 @@ Variants strip stages (results wrong for stripped ones — timing only).
 """
 import os
 import sys
-import time
 from functools import partial
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -12,6 +11,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from lightgbm_tpu import obs
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -332,7 +333,7 @@ def bench(name, **flags):
                            jax.lax.rem(i, 28)]),
                 jnp.zeros((P.TABLE_WORDS,), jnp.int32)])
             w2, lt = pl.pallas_call(
-                kern, grid_spec=grid_spec,
+                kern, name="part_bisect2", grid_spec=grid_spec,
                 out_shape=[jax.ShapeDtypeStruct(work.shape, work.dtype),
                            jax.ShapeDtypeStruct((1,), jnp.int32)],
                 input_output_aliases={1: 0},
@@ -344,13 +345,12 @@ def bench(name, **flags):
         return jax.lax.fori_loop(0, REPS, body, (work, jnp.int32(0)))
 
     for cnt in (256,):
-        out = chain(work, jnp.int32(cnt))
-        jax.block_until_ready(out)
+        obs.sync(chain(work, jnp.int32(cnt)))
         best = 1e9
         for _ in range(2):
-            t0 = time.perf_counter()
-            jax.block_until_ready(chain(work, jnp.int32(cnt)))
-            best = min(best, time.perf_counter() - t0)
+            with obs.wall("part_bisect2/stage", record=False) as w:
+                obs.sync(chain(work, jnp.int32(cnt)))
+            best = min(best, w.seconds)
         print("%-44s cnt=%5d %8.1f us/call" % (name, cnt, best / REPS * 1e6))
 
 

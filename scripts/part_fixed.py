@@ -5,13 +5,14 @@ per-call time vs cnt line gives (fixed, per-row) directly.
 """
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from lightgbm_tpu import obs
 
 jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
 
@@ -47,14 +48,12 @@ def chain(work, cnt):
 
 
 for cnt in (256, 1024, 4096, 16384, 65536, 262144):
-    out = chain(work, jnp.int32(cnt))
-    jax.block_until_ready(out)
+    obs.sync(chain(work, jnp.int32(cnt)))
     best = 1e9
     for _ in range(3):
-        t0 = time.perf_counter()
-        out = chain(work, jnp.int32(cnt))
-        jax.block_until_ready(out)
-        best = min(best, time.perf_counter() - t0)
+        with obs.wall("part_fixed/chain", record=False) as w:
+            obs.sync(chain(work, jnp.int32(cnt)))
+        best = min(best, w.seconds)
     per = best / REPS * 1e6
     print("cnt=%7d  %8.1f us/call  (%5.2f ns/row)" %
           (cnt, per, per * 1e3 / cnt))

@@ -18,7 +18,6 @@ byte-preserved.
 """
 import os
 import sys
-import time
 from functools import partial
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -26,6 +25,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from lightgbm_tpu import obs
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -117,13 +118,9 @@ def main():
     base = rng.randint(0, 256, size=(2, npad, W)).astype(np.uint8)
     work = jnp.asarray(base)
 
-    def timed(fn):
-        r = fn()
-        jax.block_until_ready(r)
-        t0 = time.perf_counter()
-        r = fn()
-        _ = np.asarray(jax.tree.leaves(r)[0]).ravel()[:1]
-        return time.perf_counter() - t0
+    # trusted wall per PERF.md discipline (obs.timed_sync): warm once,
+    # then time one call ended by a forced 1-element transfer
+    timed = obs.timed_sync
 
     def chain(K, fn, cnt, ch_):
         @jax.jit

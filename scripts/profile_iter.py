@@ -7,7 +7,6 @@ row packing, and the partition/histogram kernels at representative sizes.
 """
 import os
 import sys
-import time
 from functools import partial
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -16,19 +15,17 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from lightgbm_tpu import obs
+
 jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 N = int(os.environ.get("PROF_N", 2_000_000))
 
 
-def timed(fn):
-    r = fn()
-    jax.block_until_ready(r)
-    t0 = time.perf_counter()
-    r = fn()
-    _ = np.asarray(jax.tree.leaves(r)[0]).ravel()[:1]
-    return time.perf_counter() - t0
+# trusted wall per PERF.md discipline: warm once, then time one call
+# ended by a forced 1-element transfer (obs.timed_sync)
+timed = obs.timed_sync
 
 
 def chain_cost(make_chain, K=4):

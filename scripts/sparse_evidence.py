@@ -11,11 +11,12 @@ Usage: python scripts/sparse_evidence.py [rows]   (default 500_000)
 """
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
+
+from lightgbm_tpu import obs
 
 N = int(sys.argv[1]) if len(sys.argv) > 1 else 500_000
 
@@ -27,23 +28,23 @@ def main():
     n_cat = 130
     card = 32          # ~130 * 32 + 68 dense-ish = ~4228 raw columns
     n_dense = 68
-    t0 = time.time()
-    # one-hot blocks: exactly one hot column per categorical variable
-    cats = rng.randint(0, card, size=(N, n_cat))
-    cols = []
-    X = np.zeros((N, n_cat * card + n_dense), dtype=np.float64)
-    for j in range(n_cat):
-        X[np.arange(N), j * card + cats[:, j]] = 1.0
-    X[:, n_cat * card:] = rng.randn(N, n_dense)
-    y = (cats[:, 0] + X[:, -1] * 3 + rng.randn(N) > card / 2).astype(
-        np.float64)
+    with obs.wall("sparse_evidence/gen", record=False) as w_gen:
+        # one-hot blocks: exactly one hot column per categorical variable
+        cats = rng.randint(0, card, size=(N, n_cat))
+        cols = []
+        X = np.zeros((N, n_cat * card + n_dense), dtype=np.float64)
+        for j in range(n_cat):
+            X[np.arange(N), j * card + cats[:, j]] = 1.0
+        X[:, n_cat * card:] = rng.randn(N, n_dense)
+        y = (cats[:, 0] + X[:, -1] * 3 + rng.randn(N) > card / 2).astype(
+            np.float64)
     print("gen %.1fs: raw shape %s (%.2f GB dense f64, %.4f density of "
-          "the one-hot block)" % (time.time() - t0, X.shape,
+          "the one-hot block)" % (w_gen.seconds, X.shape,
                                   X.nbytes / 1e9, 1.0 / card), flush=True)
-    t0 = time.time()
-    ds = lgb.Dataset(X, label=y)
-    ds.construct()
-    t_cons = time.time() - t0
+    with obs.wall("sparse_evidence/construct", record=False) as w_cons:
+        ds = lgb.Dataset(X, label=y)
+        ds.construct()
+    t_cons = w_cons.seconds
     inner = ds.construct()
     G = inner.num_groups
     print("construct %.1fs: %d raw features -> %d EFB bundles "
@@ -52,12 +53,12 @@ def main():
           "need at the source)"
           % (t_cons, X.shape[1], G, N, G, N * G / 1e9, X.shape[1]),
         flush=True)
-    t0 = time.time()
-    bst = lgb.train({"objective": "binary", "num_leaves": 63,
-                     "verbosity": -1, "metric": ["auc"],
-                     "tpu_iter_block": 5}, ds, num_boost_round=10)
-    (_, _, auc, _), = bst.eval_train()
-    print("train 10 iters %.1fs auc=%.4f" % (time.time() - t0, auc),
+    with obs.wall("sparse_evidence/train", record=False) as w_tr:
+        bst = lgb.train({"objective": "binary", "num_leaves": 63,
+                         "verbosity": -1, "metric": ["auc"],
+                         "tpu_iter_block": 5}, ds, num_boost_round=10)
+        (_, _, auc, _), = bst.eval_train()
+    print("train 10 iters %.1fs auc=%.4f" % (w_tr.seconds, auc),
           flush=True)
 
 

@@ -1,12 +1,13 @@
 """Which pallas_call spec feature costs ~350us/call?"""
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
+
+from lightgbm_tpu import obs
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -64,7 +65,7 @@ def bench(name, scratch, smem_out, semN, vlimit, dimsem, vmem_in):
             scalars = jnp.stack([jax.lax.rem(i, 2), jnp.int32(1024),
                                  cnt, jax.lax.rem(i, 28)])
             w2, lt = pl.pallas_call(
-                kern, grid_spec=grid_spec,
+                kern, name="spec_bisect", grid_spec=grid_spec,
                 out_shape=[jax.ShapeDtypeStruct(work.shape, work.dtype),
                            jax.ShapeDtypeStruct((1,) if smem_out else (8, 128),
                                               jnp.int32)],
@@ -74,13 +75,12 @@ def bench(name, scratch, smem_out, semN, vlimit, dimsem, vmem_in):
             return w2, tot + lt.reshape(-1)[0]
         return jax.lax.fori_loop(0, REPS, body, (work, jnp.int32(0)))
 
-    out = chain(work, jnp.int32(256))
-    jax.block_until_ready(out)
+    obs.sync(chain(work, jnp.int32(256)))
     best = 1e9
     for _ in range(2):
-        t0 = time.perf_counter()
-        jax.block_until_ready(chain(work, jnp.int32(256)))
-        best = min(best, time.perf_counter() - t0)
+        with obs.wall("spec_bisect/stage", record=False) as w:
+            obs.sync(chain(work, jnp.int32(256)))
+        best = min(best, w.seconds)
     print("%-44s %7.1f us/call" % (name, best / REPS * 1e6))
 
 

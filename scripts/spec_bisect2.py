@@ -8,6 +8,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
+
+from lightgbm_tpu import obs
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -57,7 +59,7 @@ def bench(name, with_table, four_scalars, write_out2, use_dma):
                 scalars = jnp.stack([i.astype(jnp.int32)])
             args = (scalars, work, table) if with_table else (scalars, work)
             w2, o = pl.pallas_call(
-                kern, grid_spec=grid_spec,
+                kern, name="spec_bisect2", grid_spec=grid_spec,
                 out_shape=[jax.ShapeDtypeStruct(work.shape, work.dtype),
                            jax.ShapeDtypeStruct((256, W), jnp.uint8)],
                 input_output_aliases={1: 0},
@@ -65,13 +67,12 @@ def bench(name, with_table, four_scalars, write_out2, use_dma):
             return w2, acc + jnp.sum(o.astype(jnp.int32))
         return jax.lax.fori_loop(0, REPS, body, (work, jnp.int32(0)))
 
-    out = chain(work, jnp.int32(256))
-    jax.block_until_ready(out)
+    obs.sync(chain(work, jnp.int32(256)))
     best = 1e9
     for _ in range(2):
-        t0 = time.perf_counter()
-        jax.block_until_ready(chain(work, jnp.int32(256)))
-        best = min(best, time.perf_counter() - t0)
+        with obs.wall("spec_bisect2/stage", record=False) as w:
+            obs.sync(chain(work, jnp.int32(256)))
+        best = min(best, w.seconds)
     print("%-48s %7.1f us/call" % (name, best / REPS * 1e6))
 
 

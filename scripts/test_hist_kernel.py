@@ -1,13 +1,14 @@
 """Correctness + speed: hist_pallas_segment vs the XLA einsum path."""
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from lightgbm_tpu import obs
 
 jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
@@ -56,12 +57,12 @@ def speed(n, F, chunk=4096, reps=60):
                        **kw)
                 return acc + h[0, 0, 0]
             return jax.lax.fori_loop(0, reps, body, jnp.float32(0))
-        jax.block_until_ready(chain(work))
+        obs.sync(chain(work))
         best = 1e9
         for _ in range(3):
-            t0 = time.perf_counter()
-            jax.block_until_ready(chain(work))
-            best = min(best, time.perf_counter() - t0)
+            with obs.wall("test_hist_kernel/chain", record=False) as w:
+                obs.sync(chain(work))
+            best = min(best, w.seconds)
         return best / reps
 
     t_x = mk(hist16_segment)

@@ -1,13 +1,14 @@
 """Measure trace/lower/compile cost of the fused training block at bench shape."""
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from lightgbm_tpu import obs
 
 jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
@@ -22,12 +23,12 @@ params = {
     "learning_rate": 0.1, "verbosity": -1, "tpu_iter_block": 20,
 }
 
-t0 = time.time()
-ds = lgb.Dataset(X, label=y)
-ds.construct()
-print(f"dataset construct: {time.time()-t0:.1f}s")
+with obs.wall("trace_cost/construct", record=False) as w:
+    ds = lgb.Dataset(X, label=y)
+    ds.construct()
+print(f"dataset construct: {w.seconds:.1f}s")
 
 for rep in range(3):
-    t0 = time.time()
-    bst = lgb.train(dict(params), ds, num_boost_round=20)
-    print(f"train#{rep} 20 iters: {time.time()-t0:.1f}s")
+    with obs.wall("trace_cost/train", record=False) as w:
+        bst = lgb.train(dict(params), ds, num_boost_round=20)
+    print(f"train#{rep} 20 iters: {w.seconds:.1f}s")

@@ -1,13 +1,14 @@
 """Split per-train-call fixed cost into trace / lower / compile / run."""
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from lightgbm_tpu import obs
 
 jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
@@ -28,29 +29,29 @@ ds.construct()
 bst = lgb.train(dict(params), ds, num_boost_round=1)  # warm small pieces
 
 from lightgbm_tpu.basic import Booster
-t0 = time.time()
-b2 = Booster(params=dict(params), train_set=ds)
-print(f"Booster init: {time.time()-t0:.1f}s")
+with obs.wall("trace_cost2/init", record=False) as w:
+    b2 = Booster(params=dict(params), train_set=ds)
+print(f"Booster init: {w.seconds:.1f}s")
 g = b2.inner
 ft = FusedTrainer(g)
-t0 = time.time()
-fn = ft._block_fn(20)
-print(f"_block_fn build (no trace): {time.time()-t0:.1f}s")
+with obs.wall("trace_cost2/block_fn", record=False) as w:
+    fn = ft._block_fn(20)
+print(f"_block_fn build (no trace): {w.seconds:.1f}s")
 args = (g.train_score.score, jnp.asarray(g._cegb_used), g._key, jnp.int32(0))
-t0 = time.time()
-lowered = fn.trace(*args)
-print(f"jit trace: {time.time()-t0:.1f}s")
-t0 = time.time()
-low = lowered.lower()
-print(f"lower: {time.time()-t0:.1f}s")
-t0 = time.time()
-comp = low.compile()
-print(f"compile (persistent cache): {time.time()-t0:.1f}s")
-t0 = time.time()
-out = comp(*args)
-jax.block_until_ready(out)
-print(f"run block of 20: {time.time()-t0:.1f}s")
-t0 = time.time()
-out = comp(*args)
-jax.block_until_ready(out)
-print(f"run block of 20 (2nd): {time.time()-t0:.1f}s")
+with obs.wall("trace_cost2/trace", record=False) as w:
+    lowered = fn.trace(*args)
+print(f"jit trace: {w.seconds:.1f}s")
+with obs.wall("trace_cost2/lower", record=False) as w:
+    low = lowered.lower()
+print(f"lower: {w.seconds:.1f}s")
+with obs.wall("trace_cost2/compile", record=False) as w:
+    comp = low.compile()
+print(f"compile (persistent cache): {w.seconds:.1f}s")
+with obs.wall("trace_cost2/run", record=False) as w:
+    out = comp(*args)
+    obs.sync(out)
+print(f"run block of 20: {w.seconds:.1f}s")
+with obs.wall("trace_cost2/run2", record=False) as w:
+    out = comp(*args)
+    obs.sync(out)
+print(f"run block of 20 (2nd): {w.seconds:.1f}s")

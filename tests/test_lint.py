@@ -1,0 +1,374 @@
+"""graftlint framework + rule-set tests (ISSUE 4 tentpole).
+
+Fixture projects are written to tmp_path with the repo's directory shape
+(lightgbm_tpu/, lightgbm_tpu/ops/, scripts/, bench.py) because several
+rules scope by path. Every rule gets positive AND negative snippets; the
+suppression tests prove inline ``# graftlint: disable`` and the baseline
+each kill exactly their finding. The final tests run the real linter over
+the real repo: zero unbaselined findings, under the ~5 s tier-1 budget.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from lightgbm_tpu import lint  # noqa: E402
+
+
+def make_project(tmp_path, files):
+    """Write {relpath: source} and lint it; returns the LintResult."""
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return lint.run(str(tmp_path))
+
+
+def rules_hit(result):
+    return sorted({f.rule for f in result.findings})
+
+
+def lines_hit(result, rule):
+    return sorted(f.line for f in result.findings if f.rule == rule)
+
+
+# ------------------------------------------------------------ naked-timer
+
+def test_naked_timer_positive(tmp_path):
+    res = make_project(tmp_path, {
+        "scripts/prof.py": """\
+            import time
+            from time import perf_counter as pc
+            t0 = time.time()
+            t1 = pc()
+        """,
+        "bench.py": """\
+            import time
+            t0 = time.perf_counter()
+        """,
+    })
+    found = [(f.path, f.line) for f in res.findings
+             if f.rule == "naked-timer"]
+    assert ("scripts/prof.py", 3) in found       # time.time()
+    assert ("scripts/prof.py", 4) in found       # aliased perf_counter
+    assert ("bench.py", 2) in found
+
+
+def test_naked_timer_negative(tmp_path):
+    res = make_project(tmp_path, {
+        # the two timing-impl modules are exempt
+        "lightgbm_tpu/obs.py": "import time\nt0 = time.perf_counter()\n",
+        "lightgbm_tpu/utils/timer.py":
+            "import time\nt0 = time.perf_counter()\n",
+        # obs.wall usage is the blessed pattern
+        "scripts/good.py": """\
+            from lightgbm_tpu import obs
+            with obs.wall("phase", record=False) as w:
+                pass
+            print(w.seconds)
+        """,
+        # time.sleep is not a wall clock
+        "scripts/sleepy.py": "import time\ntime.sleep(0.0)\n",
+    })
+    assert "naked-timer" not in rules_hit(res)
+
+
+# ------------------------------------------------------------ host-sync
+
+def test_host_sync_positive(tmp_path):
+    res = make_project(tmp_path, {"lightgbm_tpu/ops/k.py": """\
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from functools import partial
+
+        def helper(x):
+            return float(jnp.sum(x))
+
+        @jax.jit
+        def kernel(x):
+            y = helper(x)
+            v = x.item()
+            z = np.asarray(x)
+            return y + v + z.sum()
+
+        def make(seed):
+            return partial(body, seed)
+
+        def body(seed, x):
+            x.block_until_ready()
+            return x
+
+        @jax.jit
+        def loop_hot(x):
+            def inner(i, c):
+                return jnp.asarray(c.tolist())
+            return jax.lax.fori_loop(0, 4, inner, x)
+    """})
+    got = lines_hit(res, "host-sync")
+    assert 7 in got     # float(jnp...) in helper reachable from kernel
+    assert 12 in got    # .item() in the jit body
+    assert 13 in got    # np.asarray in the jit body
+    assert 20 in got    # block_until_ready via partial-wrapped body
+    assert 26 in got    # .tolist() in a nested def of a hot fn
+
+
+def test_host_sync_negative(tmp_path):
+    res = make_project(tmp_path, {"lightgbm_tpu/ops/k.py": """\
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        def host_side(x):
+            return np.asarray(x)          # never reachable from a jit
+
+        def configure(cfg):
+            return int(cfg.seed), float(cfg.rate)   # static scalars
+
+        @jax.jit
+        def kernel(x, n):
+            shape = int(x.shape[0])       # int() on a non-jnp expression
+            return x * n + shape
+    """})
+    assert "host-sync" not in rules_hit(res)
+
+
+def test_host_sync_scoped_name_resolution(tmp_path):
+    """A hot function calling its LOCAL helper must not mark an unrelated
+    same-named method hot — the FusedTrainer.flush false-positive class."""
+    res = make_project(tmp_path, {"lightgbm_tpu/ops/k.py": """\
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def kernel(x):
+            def flush(v):
+                return v + 1
+            return flush(x)
+
+        class Trainer:
+            def flush(self, x):
+                return np.asarray(x)      # host-side; same simple name
+    """})
+    assert "host-sync" not in rules_hit(res)
+
+
+def test_host_sync_method_calls_resolve(tmp_path):
+    """x.attr(...) calls from hot code DO reach methods of that name."""
+    res = make_project(tmp_path, {"lightgbm_tpu/ops/k.py": """\
+        import jax
+
+        class Comm:
+            def psum(self, x):
+                return x.item()
+
+        @jax.jit
+        def kernel(x, comm):
+            return comm.psum(x)
+    """})
+    assert lines_hit(res, "host-sync") == [5]
+
+
+# ------------------------------------------------------------ implicit-dtype
+
+def test_implicit_dtype_positive(tmp_path):
+    res = make_project(tmp_path, {"lightgbm_tpu/ops/k.py": """\
+        import jax.numpy as jnp
+        a = jnp.zeros((4,))
+        b = jnp.arange(8)
+        c = jnp.asarray([1, 2])
+        d = jnp.ones((2, 2))
+        e = jnp.full((3,), 0)
+    """})
+    assert lines_hit(res, "implicit-dtype") == [2, 3, 4, 5, 6]
+
+
+def test_implicit_dtype_negative(tmp_path):
+    res = make_project(tmp_path, {
+        "lightgbm_tpu/ops/k.py": """\
+            import jax.numpy as jnp
+            a = jnp.zeros((4,), jnp.int32)          # positional dtype
+            b = jnp.arange(8, dtype=jnp.uint8)      # kwarg dtype
+            c = jnp.asarray([1], jnp.float32)
+            d = jnp.full((3,), 0, jnp.int32)
+            e = jnp.arange(2, 8, 2, jnp.int32)      # dtype at position 3
+        """,
+        # outside ops/ the rule does not apply
+        "lightgbm_tpu/other.py":
+            "import jax.numpy as jnp\nx = jnp.zeros((4,))\n",
+    })
+    assert "implicit-dtype" not in rules_hit(res)
+
+
+# ------------------------------------------------------------ unnamed-pallas-call
+
+def test_unnamed_pallas_call(tmp_path):
+    res = make_project(tmp_path, {"lightgbm_tpu/ops/k.py": """\
+        from jax.experimental import pallas as pl
+        bad = pl.pallas_call(lambda r: None, out_shape=None)
+        good = pl.pallas_call(lambda r: None, out_shape=None, name="k")
+    """})
+    assert lines_hit(res, "unnamed-pallas-call") == [2]
+
+
+# ------------------------------------------------------------ mutable-default
+
+def test_mutable_default(tmp_path):
+    res = make_project(tmp_path, {"lightgbm_tpu/m.py": """\
+        def bad(x, acc=[]):
+            return acc
+        def bad2(*, table={}):
+            return table
+        def good(x, acc=(), n=0, s=None):
+            return acc
+    """})
+    assert lines_hit(res, "mutable-default") == [1, 3]
+
+
+# ------------------------------------------------------------ module-mutable-state
+
+def test_module_mutable_state(tmp_path):
+    res = make_project(tmp_path, {"lightgbm_tpu/m.py": """\
+        CACHE = {}
+        TABLE = {"a": 1}      # written only at module init: legal
+
+        def put(k, v):
+            CACHE[k] = v
+    """})
+    assert lines_hit(res, "module-mutable-state") == [1]
+
+
+def test_module_mutable_state_obs_exempt(tmp_path):
+    res = make_project(tmp_path, {"lightgbm_tpu/obs.py": """\
+        REGISTRY = {}
+
+        def register(k, v):
+            REGISTRY[k] = v
+    """})
+    assert "module-mutable-state" not in rules_hit(res)
+
+
+# ------------------------------------------------------------ suppression
+
+def test_inline_disable_suppresses_exactly_its_rule(tmp_path):
+    res = make_project(tmp_path, {"scripts/s.py": """\
+        import time
+        a = time.time()  # graftlint: disable=naked-timer
+        b = time.time()  # graftlint: disable=implicit-dtype
+        c = time.time()
+    """})
+    assert lines_hit(res, "naked-timer") == [3, 4]
+    sup = [f.line for f in res.suppressed if f.rule == "naked-timer"]
+    assert sup == [2]
+
+
+def test_inline_disable_all_rules(tmp_path):
+    res = make_project(tmp_path, {"scripts/s.py": """\
+        import time
+        a = time.time()  # graftlint: disable
+    """})
+    assert not res.findings
+    assert len(res.suppressed) == 1
+
+
+# ------------------------------------------------------------ baseline
+
+def test_baseline_freezes_only_its_findings(tmp_path):
+    res = make_project(tmp_path, {"scripts/s.py": """\
+        import time
+        a = time.time()
+    """})
+    baseline = lint.baseline_from_findings(res.findings)
+    new, old = lint.split_new_findings(res.findings, baseline)
+    assert not new and len(old) == 1
+
+    # an ADDITIONAL identical-text finding exceeds the count budget
+    res2 = make_project(tmp_path, {"scripts/s.py": """\
+        import time
+        a = time.time()
+        a = time.time()
+    """})
+    new2, old2 = lint.split_new_findings(res2.findings, baseline)
+    assert len(old2) == 1 and len(new2) == 1
+
+
+def test_baseline_survives_line_renumbering(tmp_path):
+    res = make_project(tmp_path, {"scripts/s.py": """\
+        import time
+        a = time.time()
+    """})
+    baseline = lint.baseline_from_findings(res.findings)
+    # same offending line, pushed down by an unrelated edit
+    res2 = make_project(tmp_path, {"scripts/s.py": """\
+        import time
+        x = 1
+        y = 2
+        a = time.time()
+    """})
+    new, old = lint.split_new_findings(res2.findings, baseline)
+    assert not new and len(old) == 1
+
+
+def test_baseline_roundtrip(tmp_path):
+    res = make_project(tmp_path, {"scripts/s.py": """\
+        import time
+        a = time.time()
+    """})
+    path = str(tmp_path / "baseline.json")
+    lint.save_baseline(path, lint.baseline_from_findings(res.findings))
+    loaded = lint.load_baseline(path)
+    new, old = lint.split_new_findings(res.findings, loaded)
+    assert not new and len(old) == 1
+    assert lint.load_baseline(str(tmp_path / "missing.json")) \
+        == {"version": 1, "findings": []}
+
+
+# ------------------------------------------------------------ framework
+
+def test_rule_registry_and_selection(tmp_path):
+    ids = set(lint.all_rules())
+    assert {"naked-timer", "host-sync", "implicit-dtype",
+            "unnamed-pallas-call", "mutable-default",
+            "module-mutable-state"} <= ids
+    with pytest.raises(ValueError):
+        lint.run(str(tmp_path), rules=["no-such-rule"])
+
+
+def test_finding_render_format(tmp_path):
+    res = make_project(tmp_path, {"scripts/s.py":
+                                  "import time\na = time.time()\n"})
+    (f,) = [x for x in res.findings if x.rule == "naked-timer"]
+    assert f.render().startswith("scripts/s.py:2:")
+    assert ": naked-timer " in f.render()
+
+
+# ------------------------------------------------------------ the real repo
+
+def test_repo_is_lint_clean():
+    """python scripts/lint.py must exit 0: no unbaselined findings."""
+    result = lint.run(REPO)
+    baseline = lint.load_baseline(os.path.join(REPO, lint.BASELINE_NAME))
+    new, _ = lint.split_new_findings(result.findings, baseline)
+    assert not new, "\n" + "\n".join(f.render() for f in new)
+
+
+def test_full_lint_is_fast():
+    t0 = time.perf_counter()
+    lint.run(REPO)
+    assert time.perf_counter() - t0 < 5.0
+
+
+def test_cli_json_exit_zero():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "lint.py"), "--json"],
+        capture_output=True, text=True, cwd=REPO)
+    assert out.returncode == 0, out.stdout + out.stderr
+    payload = json.loads(out.stdout)
+    assert payload["ok"] is True and payload["new"] == []

@@ -273,13 +273,6 @@ def test_log_sink_global_with_thread_override():
     assert any("threaded" in m for m in thread_lines)
 
 
-# -------------------------------------------------------------- hygiene
-
-def test_no_naked_walls():
-    """bench.py and the migrated scripts must use lightgbm_tpu.obs, never
-    raw time.time() walls (PERF.md measurement discipline)."""
-    files = ["bench.py", "scripts/profile_wall.py",
-             "scripts/resident_bisect.py", "scripts/layout_bisect.py"]
-    for rel in files:
-        text = open(os.path.join(REPO, rel)).read()
-        assert "time.time(" not in text, "%s has a naked time.time() wall" % rel
+# The naked-walls grep that lived here is superseded by graftlint's
+# naked-timer rule (lightgbm_tpu/lint/rules.py), which covers ALL of
+# lightgbm_tpu/, scripts/ and bench.py — see tests/test_lint.py.

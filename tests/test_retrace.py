@@ -1,0 +1,124 @@
+"""Runtime retrace / compile-budget detector (ISSUE 4 tentpole).
+
+obs.track_jit wraps every training-path jit entry point, turning
+compiled-cache growth into ``jit/compiles/<name>`` telemetry counters.
+These tests pin the contract the round-5 "dispatch soup" regression
+violated: a first train pays a bounded number of compilations, and a
+second train at identical shapes/config pays ZERO — every jit entry must
+hit its cache (fused path: the cross-Booster _BLOCK_CACHE).
+"""
+import os
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+import lightgbm_tpu as lgb  # noqa: E402
+from lightgbm_tpu import obs  # noqa: E402
+
+#: first-train ceiling for TRACKED entry-point compiles. The fused path
+#: compiles run_block once; the eager path adds learner/build, grads,
+#: score_add and assign_leaves. Anything near double this is a retrace
+#: leak, not workload growth.
+PER_TRAIN_COMPILE_BUDGET = 8
+
+
+def _data(n=600, f=8, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = (X[:, 0] + 0.1 * rng.randn(n) > 0).astype(np.float64)
+    return X, y
+
+
+PARAMS = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+          "tpu_iter_block": 5}
+
+
+# ------------------------------------------------------------ track_jit unit
+
+def test_track_jit_counts_traces():
+    obs.telemetry.reset()
+    calls = []
+
+    @jax.jit
+    def f(x):
+        calls.append(None)
+        return x * 2
+
+    g = obs.track_jit("test/f", f)
+    g(jnp.ones((4,)))
+    assert obs.jit_compiles().get("test/f") == 1
+    g(jnp.ones((4,)))                      # cache hit: no growth
+    assert obs.jit_compiles().get("test/f") == 1
+    g(jnp.ones((8,)))                      # new shape: retrace
+    assert obs.jit_compiles().get("test/f") == 2
+
+
+def test_track_jit_delegates_attributes():
+    @jax.jit
+    def f(x):
+        return x + 1
+
+    g = obs.track_jit("test/delegate", f)
+    lowered = g.lower(jnp.ones((2,)))      # PjitFunction API passes through
+    assert lowered is not None
+    # re-wrapping re-labels instead of stacking wrappers
+    h = obs.track_jit("test/relabel", g)
+    assert h._fn is f
+
+
+def test_snapshot_exposes_jit_compiles():
+    obs.telemetry.reset()
+
+    @jax.jit
+    def f(x):
+        return x - 1
+
+    obs.track_jit("test/snap", f)(jnp.ones((2,)))
+    snap = obs.telemetry.snapshot()
+    jc = snap["jit_compiles"]
+    assert jc["per_function"] == {"test/snap": 1}
+    assert jc["total"] == 1
+    assert jc["backend_compiles"] >= 1     # global listener saw the compile
+
+
+# ------------------------------------------------------------ train budgets
+
+def test_first_train_within_compile_budget():
+    X, y = _data()
+    ds = lgb.Dataset(X, label=y)
+    obs.telemetry.reset()
+    bst = lgb.train(dict(PARAMS), ds, num_boost_round=5)
+    jc = bst.telemetry()["jit_compiles"]
+    assert jc["total"] >= 1, "no tracked jit entry point ran"
+    assert jc["total"] <= PER_TRAIN_COMPILE_BUDGET, jc
+    assert "fused/run_block" in jc["per_function"], jc
+
+
+def test_second_identical_train_compiles_nothing():
+    X, y = _data()
+    ds = lgb.Dataset(X, label=y)
+    lgb.train(dict(PARAMS), ds, num_boost_round=5)       # warm every cache
+    obs.telemetry.reset()
+    bst = lgb.train(dict(PARAMS), ds, num_boost_round=5)
+    jc = bst.telemetry()["jit_compiles"]
+    assert jc["total"] == 0, jc
+    assert jc["backend_compiles"] == 0, jc
+
+
+def test_bench_json_carries_jit_compiles():
+    """bench.py embeds telemetry.snapshot(); the jit_compiles section must
+    be json-serializable and present."""
+    import json
+    X, y = _data(300, 6)
+    ds = lgb.Dataset(X, label=y)
+    obs.telemetry.reset()
+    bst = lgb.train(dict(PARAMS), ds, num_boost_round=3)
+    snap = json.loads(json.dumps(bst.telemetry()))
+    assert "jit_compiles" in snap
+    assert snap["jit_compiles"]["total"] >= 0
