@@ -31,6 +31,7 @@ RANK_ROWS = int(os.environ.get("BENCH_RANK_ROWS", 2_270_000))
 RANK_ITER = int(os.environ.get("BENCH_RANK_ITERS", 30))
 SKIP_RANK = os.environ.get("BENCH_SKIP_RANK", "") == "1"
 SKIP_2M = os.environ.get("BENCH_SKIP_2M", "") == "1"
+SKIP_SERVE = os.environ.get("BENCH_SKIP_SERVE", "") == "1"
 
 # reference CPU: Higgs 130.094 s / (500 iter * 10.5M rows); MSLR 70.417 s /
 # (500 * 2.27M)  [BASELINE.md, docs/Experiments.rst:109-123]
@@ -218,6 +219,22 @@ def main():
             result["rank_train_breakdown"] = r_ph
         except Exception as e:  # pragma: no cover - report, don't fail
             result["rank_error"] = "%s: %s" % (type(e).__name__, str(e)[:200])
+    if not SKIP_SERVE:
+        try:
+            # serving sidecar: session+batcher throughput vs naive
+            # Booster.predict loop (full harness: scripts/serve_bench.py)
+            from lightgbm_tpu.serve.bench import run_serve_bench
+            sb = run_serve_bench(requests=256, trees=60, num_leaves=63,
+                                 n_features=28, train_rows=10_000,
+                                 closed_loop_requests=64)
+            result["serve_value"] = sb["value"]
+            result["serve_unit"] = sb["unit"]
+            result["serve_vs_naive"] = sb["vs_baseline"]
+            result["serve_p50_ms"] = sb["closed_loop_p50_ms"]
+            result["serve_p99_ms"] = sb["closed_loop_p99_ms"]
+        except Exception as e:  # pragma: no cover - report, don't fail
+            result["serve_error"] = "%s: %s" % (type(e).__name__,
+                                                str(e)[:200])
     # full structured-counter view of the run (dataset cache traffic, fused
     # dispatch/flush, per-tree growth, auto-knob resolutions, bench walls)
     result["telemetry"] = lgb.obs.telemetry.snapshot()

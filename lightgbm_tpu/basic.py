@@ -421,6 +421,8 @@ class Booster:
                     tree.leaf_value[l] = decay * tree.leaf_value[l] + \
                         (1 - decay) * new_val * tree.shrinkage
             score[:, i % K] += tree.predict(X)
+        # leaf values were rewritten in place on the fresh booster's trees
+        new_booster.inner._bump_model_version()
         return new_booster
 
 

@@ -94,6 +94,11 @@ class GBDT:
         self._grad_fn = None
         self.best_iteration = -1
         self.comm_axis = comm_axis
+        # monotonic token bumped whenever self.models changes content —
+        # train/rollback/score-rebuild/fused-commit. Device-resident
+        # prediction packs key on it (an (len, id(tree)) key is unsafe:
+        # rollback + retrain can reproduce both with different trees)
+        self._model_version = 0
         if train_set is not None:
             self._setup(train_set)
 
@@ -316,6 +321,7 @@ class GBDT:
             if tree.num_leaves > 1:
                 any_nonconstant = True
         self.iter_ += 1
+        self._bump_model_version()
         return not any_nonconstant
 
     def _note_used_features(self, tree: Tree) -> None:
@@ -502,10 +508,14 @@ class GBDT:
             tree = self.models.pop()
             del tree
         self.iter_ -= 1
+        self._bump_model_version()
         # scores must be rebuilt; mark dirty and recompute lazily
         self._rebuild_scores()
 
     def _rebuild_scores(self) -> None:
+        # callers reach here after mutating self.models (rollback, continued
+        # training preload) — invalidate any device-resident predict packs
+        self._bump_model_version()
         K = self.num_tree_per_iteration
 
         def fresh_tracker(ds: BinnedDataset) -> ScoreTracker:
@@ -564,6 +574,59 @@ class GBDT:
     # ---------------------------------------------------------------- predict
     DEVICE_PREDICT_MIN_ROWS = 512
 
+    @property
+    def model_version(self) -> int:
+        """Monotonic model-content token (see __init__)."""
+        return self._model_version
+
+    def _bump_model_version(self) -> None:
+        self._model_version += 1
+
+    def _packed_model(self, start: int, end: int):
+        """Device-resident ``PackedSplits`` for iterations [start, end).
+
+        Cached behind the model-version token so repeat predicts pay zero
+        host re-packs and zero uploads (``serve/pack_build`` vs
+        ``serve/pack_hit`` counters); continued training, rollback and
+        score rebuilds bump the version and naturally invalidate. All
+        PredictSessions over this booster share the cache."""
+        from .obs import telemetry
+        from .ops.predict import pack_splits
+
+        cache = getattr(self, "_pack_cache", None)
+        if cache is None or not isinstance(cache, dict):
+            cache = self._pack_cache = {}
+        key = (start, end, self._model_version)
+        hit = cache.get(key)
+        if hit is not None:
+            telemetry.count("serve/pack_hit")
+            return hit
+        if len(cache) > 16:
+            cache.clear()
+        telemetry.count("serve/pack_build")
+        K = self.num_tree_per_iteration
+        hit = cache[key] = pack_splits(self.models[start * K:end * K],
+                                       num_class=K)
+        return hit
+
+    def _predict_session(self, start: int, end: int):
+        """Lazily created serving session per iteration range (the device
+        predict path of ``_raw_scores_range``). Sessions hold only bucket
+        warm-state; the pack itself lives in the shared version-keyed
+        ``_packed_model`` cache."""
+        from .serve.session import PredictSession
+
+        cache = getattr(self, "_serve_sessions", None)
+        if cache is None:
+            cache = self._serve_sessions = {}
+        sess = cache.get((start, end))
+        if sess is None:
+            if len(cache) > 32:
+                cache.clear()
+            sess = cache[(start, end)] = PredictSession(
+                self, start_iteration=start, num_iteration=end - start)
+        return sess
+
     def _raw_scores(self, X: np.ndarray, start: int, end: int) -> np.ndarray:
         """Ensemble raw scores with optional prediction early stopping
         (reference: src/boosting/prediction_early_stop.cpp — rows whose
@@ -614,23 +677,7 @@ class GBDT:
         models = self.models[start * K:end * K]
         has_linear = any(getattr(t, "is_linear", False) for t in models)
         if n >= self.DEVICE_PREDICT_MIN_ROWS and models and not has_linear:
-            from .ops.predict import pack_splits, predict_raw
-
-            key = (start, end, len(self.models),
-                   id(self.models[-1]) if self.models else 0)
-            cache = getattr(self, "_pack_cache", None)
-            if cache is None or not isinstance(cache, dict):
-                cache = self._pack_cache = {}
-            hit = cache.get(key)
-            if hit is None:
-                if len(cache) > 64:
-                    cache.clear()
-                hit = cache[key] = pack_splits(models, num_class=K)
-            pack, has_cat = hit
-            score = predict_raw(jnp.asarray(X, jnp.float32), pack,
-                                num_class=K, has_cat=has_cat)
-            out = np.asarray(score, np.float64)
-            return out.reshape(n, K) if K > 1 else out[:, None]
+            return self._predict_session(start, end).raw_scores(X)
         score = np.zeros((n, K), dtype=np.float64)
         for i, t in enumerate(models):
             score[:, (start * K + i) % K] += t.predict(X)
@@ -950,6 +997,10 @@ class DART(GBDT):
                         tree = self.models[it_idx * K + k]
                         self._apply_tree_delta(tree, k, factor)
                         tree.apply_shrinkage(factor)
+            # normalization mutates committed trees in place AFTER the
+            # super() bump — bump again so predict packs never serve stale
+            # pre-normalization leaf values
+            self._bump_model_version()
         return stop
 
     def _shrinkage_rate(self, log: TreeLog) -> float:
@@ -1003,6 +1054,7 @@ class RF(GBDT):
             if tree.num_leaves > 1:
                 any_ok = True
         self.iter_ += 1
+        self._bump_model_version()
         return not any_ok
 
     def _accumulate_avg(self, tree: Tree, log: TreeLog, class_id: int) -> None:

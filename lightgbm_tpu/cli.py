@@ -76,6 +76,8 @@ class Application:
             self.refit()
         elif task == "save_binary":
             self.save_binary()
+        elif task == "serve":
+            self.serve()
         else:
             Log.fatal("Unknown task: %s", task)
 
@@ -166,6 +168,35 @@ class Application:
         ds = self._load_train_data()
         ds.save_binary(cfg.data + ".bin")
         Log.info("Saved binary dataset to %s.bin", cfg.data)
+
+    def serve(self) -> None:
+        """task=serve: stdlib-HTTP JSON prediction endpoint over a loaded
+        model (POST /predict {"rows": [[...]]}; GET /healthz, /telemetry).
+        Device-resident pack + bucket-ladder compiled predict + request
+        micro-batching — see lightgbm_tpu/serve/."""
+        cfg = self.config
+        if not cfg.input_model:
+            Log.fatal("task=serve requires input_model")
+        bst = Booster(model_file=cfg.input_model)
+        from .serve.http import PredictServer
+        server = PredictServer(
+            bst, host=cfg.serve_host, port=cfg.serve_port,
+            max_batch_rows=cfg.serve_max_batch_rows,
+            max_wait_ms=cfg.serve_max_wait_ms,
+            buckets=cfg.serve_buckets or None,
+            raw_score=cfg.predict_raw_score,
+            warmup=cfg.serve_warmup)
+        host, port = server.address
+        Log.info("Serving %s on http://%s:%d (POST /predict; GET /healthz, "
+                 "/telemetry)", cfg.input_model, host, port)
+        try:
+            server.serve_forever()
+        except KeyboardInterrupt:
+            # return normally so main() still honors --dump-telemetry —
+            # serving counters must survive the process
+            Log.info("serve: interrupted, shutting down")
+        finally:
+            server.close()
 
 
 def main(argv: Optional[List[str]] = None) -> None:

@@ -16,7 +16,7 @@ from typing import Any, Dict, List, Optional, Union
 
 from .utils.log import Log
 
-TaskType = str  # train | predict | convert_model | refit | save_binary
+TaskType = str  # train | predict | convert_model | refit | save_binary | serve
 
 
 def _parse_int_list(v: Any) -> List[int]:
@@ -172,6 +172,15 @@ class Config:
     pred_early_stop_margin: float = 10.0
     output_result: str = "LightGBM_predict_result.txt"
 
+    # ---- serving (task=serve: lightgbm_tpu/serve/ HTTP endpoint) ----
+    serve_host: str = "127.0.0.1"
+    serve_port: int = 8080            # 0 = bind an ephemeral port
+    serve_max_batch_rows: int = 8192  # MicroBatcher coalescing cap (rows)
+    serve_max_wait_ms: float = 2.0    # MicroBatcher first-request deadline
+    serve_buckets: List[int] = field(default_factory=list)  # [] = default
+    #   shape-bucket ladder (serve.session.DEFAULT_BUCKETS)
+    serve_warmup: bool = True         # pre-compile the ladder on startup
+
     # ---- objective (reference: config.h "Objective Parameters") ----
     num_class: int = 1
     is_unbalance: bool = False
@@ -307,6 +316,17 @@ class Config:
         if self.tpu_resident_state not in ("auto", "off", "on"):
             Log.fatal("tpu_resident_state must be auto, off or on; got %s",
                       self.tpu_resident_state)
+        if not 0 <= self.serve_port <= 65535:
+            Log.fatal("serve_port must be in [0, 65535], got %d",
+                      self.serve_port)
+        if self.serve_max_batch_rows < 1:
+            Log.fatal("serve_max_batch_rows must be >= 1, got %d",
+                      self.serve_max_batch_rows)
+        if self.serve_max_wait_ms < 0:
+            Log.fatal("serve_max_wait_ms must be >= 0, got %g",
+                      self.serve_max_wait_ms)
+        if any(b < 1 for b in self.serve_buckets):
+            Log.fatal("serve_buckets must be positive row counts")
         warned = getattr(self, "_noop_warned", None)
         if warned is None:
             warned = set()

@@ -135,6 +135,13 @@ def train(
         # SaveModelToFile(model.snapshot_iter_N) every snapshot_freq iters)
         if snapshot_freq > 0 and (it + 1) % snapshot_freq == 0:
             booster.save_model("%s.snapshot_iter_%d" % (snapshot_base, it + 1))
+            # snapshots used to drop telemetry; a killed run should leave
+            # its counters next to the last model it saved
+            dump = str(params.get("dump_telemetry") or "")
+            if dump:
+                import json
+                with open(dump, "w") as f:
+                    json.dump(telemetry.snapshot(), f, indent=2)
         evals = []
         with global_timer.timed("metric eval"):
             if has_train_in_valid:

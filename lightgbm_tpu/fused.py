@@ -487,14 +487,16 @@ class FusedTrainer:
             dev = self._cegb_used_dev
             if dev is not None:
                 try:
-                    self.gbdt._cegb_used = np.asarray(dev)
+                    # np.array, not asarray: a device buffer viewed through
+                    # asarray is read-only, which breaks continued training
+                    self.gbdt._cegb_used = np.array(dev)
                     self._cegb_used_dev = None
                 except Exception:
                     pass
             raise
         dev = self._cegb_used_dev
         if dev is not None:
-            self.gbdt._cegb_used = np.asarray(dev)
+            self.gbdt._cegb_used = np.array(dev)
             self._cegb_used_dev = None
         return stopped
 
@@ -533,6 +535,7 @@ class FusedTrainer:
         # atomic commit: models/iter_ move together only on full success
         gbdt.models.extend(trees)
         gbdt.iter_ += k
+        gbdt._bump_model_version()
         self._count_trees(trees)
         return last_iter_constant
 

@@ -1421,9 +1421,7 @@ def leaf_values_by_row(leaf_value: jax.Array, row_leaf: jax.Array,
     f32 HIGHEST matmul with a 0/1 operand.
     """
     n = row_leaf.shape[0]
-    pad = (-n) % chunk
-    rl = jnp.pad(row_leaf, (0, pad)) if pad else row_leaf
-    iota = jnp.arange(num_leaves, dtype=rl.dtype)
+    iota = jnp.arange(num_leaves, dtype=row_leaf.dtype)
     lv = leaf_value.astype(jnp.float32)
 
     def one(rl_c):
@@ -1432,8 +1430,12 @@ def leaf_values_by_row(leaf_value: jax.Array, row_leaf: jax.Array,
                            precision=jax.lax.Precision.HIGHEST,
                            preferred_element_type=jnp.float32)[:, 0]
 
-    if pad == 0 and n <= chunk:
-        return one(rl)
+    if n <= chunk:
+        # no padding below one chunk — serving buckets sit far under the
+        # chunk size and must not pay a 65536-row contraction for 256 rows
+        return one(row_leaf)
+    pad = (-n) % chunk
+    rl = jnp.pad(row_leaf, (0, pad)) if pad else row_leaf
     out = jax.lax.map(one, rl.reshape(-1, chunk))
     return out.reshape(-1)[:n]
 

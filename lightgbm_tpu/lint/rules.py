@@ -99,7 +99,7 @@ class NakedTimerRule(Rule):
 # ---------------------------------------------------------------------------
 
 _HOT_FILES = ("lightgbm_tpu/learner.py", "lightgbm_tpu/fused.py")
-_HOT_DIR = "lightgbm_tpu/ops/"
+_HOT_DIRS = ("lightgbm_tpu/ops/", "lightgbm_tpu/serve/")
 
 _SYNC_ATTR_CALLS = {"item", "tolist", "block_until_ready"}
 _SYNC_DOTTED = {"numpy.asarray", "numpy.array", "numpy.ascontiguousarray",
@@ -166,7 +166,7 @@ class HostSyncRule(Rule):
     ``np.asarray`` in the per-split loop serializes the pipeline).
 
     Reachability is a lexically-scoped call graph over learner.py,
-    fused.py and ops/: entries are jit-decorated functions and functions
+    fused.py, ops/ and serve/: entries are jit-decorated functions and functions
     wrapped by value in ``jax.jit``/``partial`` (the learner hands
     ``partial(build_tree*, ...)`` to jit); edges follow bare-name calls
     (resolved innermost-scope-first, never to methods), ``x.attr(...)``
@@ -182,7 +182,7 @@ class HostSyncRule(Rule):
     def check_project(self, project: Project) -> Iterator[Finding]:
         hot_files = [f for f in project.files
                      if f.tree is not None
-                     and (f.rel in _HOT_FILES or f.rel.startswith(_HOT_DIR))]
+                     and (f.rel in _HOT_FILES or f.rel.startswith(_HOT_DIRS))]
         if not hot_files:
             return
         infos: List[_FnInfo] = []

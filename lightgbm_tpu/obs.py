@@ -65,6 +65,15 @@ def sync(x) -> Optional[Any]:
     return None
 
 
+def monotonic() -> float:
+    """Monotonic timestamp (``perf_counter``) for spans that cannot be a
+    ``with`` block — e.g. the serve MicroBatcher measures submit->delivery
+    latency across threads, so the start and end of the span live in
+    different frames. Pure host clock read; callers pair two of these and
+    feed the difference to :meth:`Telemetry.add_time`."""
+    return time.perf_counter()
+
+
 class WallTimer:
     """Result handle yielded by :func:`wall`; ``seconds`` is set on exit."""
 

@@ -21,7 +21,6 @@ needed).
 """
 from __future__ import annotations
 
-from functools import partial
 from typing import List, NamedTuple
 
 import jax
@@ -127,11 +126,15 @@ def _route_tree(X, tp, has_cat: bool):
     return jax.lax.fori_loop(0, max_r, step, jnp.zeros((n,), jnp.int32))
 
 
-@partial(jax.jit, static_argnames=("num_class", "has_cat", "tree_batch"))
-def predict_raw(X: jax.Array, pack: PackedSplits, *, num_class: int = 1,
-                has_cat: bool = False, tree_batch: int = 8,
-                init_score=None) -> jax.Array:
-    """(N, F) raw rows -> (N,) or (N, K) raw ensemble scores."""
+def predict_raw_impl(X: jax.Array, pack: PackedSplits, *, num_class: int = 1,
+                     has_cat: bool = False, tree_batch: int = 8,
+                     init_score=None) -> jax.Array:
+    """(N, F) raw rows -> (N,) or (N, K) raw ensemble scores.
+
+    Un-jitted body shared by the training-path ``predict_raw`` below and
+    the serving path's shape-bucketed jit (serve/session.py) — both wrap
+    it with their own ``jax.jit`` + ``track_jit`` label so compile counts
+    stay attributable per entry point."""
     from ..learner import leaf_values_by_row
 
     n = X.shape[0]
@@ -168,7 +171,8 @@ def predict_raw(X: jax.Array, pack: PackedSplits, *, num_class: int = 1,
     return score
 
 
-predict_raw = track_jit("ops/predict_raw", predict_raw)
+predict_raw = track_jit("ops/predict_raw", jax.jit(
+    predict_raw_impl, static_argnames=("num_class", "has_cat", "tree_batch")))
 
 
 def tree_to_bin_log(tree, dataset):

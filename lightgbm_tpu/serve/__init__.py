@@ -1,0 +1,23 @@
+"""TPU-native inference subsystem.
+
+Training keeps the model on host as a ``List[Tree]``; serving inverts
+that: :class:`PredictSession` uploads the packed ensemble ONCE, keeps it
+device-resident behind the booster's model-version token, and compiles the
+batched predict against a fixed shape-bucket ladder (round N up, pad,
+slice) so steady-state traffic pays zero host re-packs and zero retraces.
+:class:`MicroBatcher` coalesces concurrent requests into one device
+dispatch; :class:`PredictServer` exposes the pair as a stdlib-HTTP JSON
+endpoint (``task=serve`` in the CLI).
+
+    session = lgb.serve.PredictSession(booster)
+    session.warmup()                       # pre-compile the bucket ladder
+    preds = session.predict(X)             # padded to the covering bucket
+    with lgb.serve.MicroBatcher(session) as mb:
+        fut = mb.submit(x_row)             # coalesced device dispatch
+        preds = fut.result()
+"""
+from .batcher import MicroBatcher
+from .http import PredictServer
+from .session import PredictSession
+
+__all__ = ["PredictSession", "MicroBatcher", "PredictServer"]

@@ -1,0 +1,191 @@
+"""Micro-batching request coalescer for the serving path.
+
+A single background worker drains a submit queue, coalescing concurrent
+``submit(X)`` calls into ONE bucketed device dispatch per batch — ensemble
+inference throughput is won by amortizing launches over large coalesced
+batches, so at batch size 1 the dominant cost is dispatch, not math. Two
+knobs bound the trade: ``max_batch_rows`` caps how much a batch grows,
+``max_wait_ms`` caps how long the first request in a batch waits for
+company.
+
+Results come back through ``concurrent.futures.Future``; a worker
+exception fails every future of its batch (callers see the real error,
+the worker keeps serving). ``close()`` drains and fails whatever is still
+queued, then joins the thread.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from collections import deque
+from concurrent.futures import Future
+from typing import Optional
+
+import numpy as np
+
+from .. import obs
+from ..obs import telemetry
+
+_STOP = object()
+
+
+class _Request:
+    __slots__ = ("X", "rows", "future", "t0")
+
+    def __init__(self, X: np.ndarray) -> None:
+        self.X = X
+        self.rows = X.shape[0]
+        self.future: Future = Future()
+        self.t0 = obs.monotonic()
+
+
+class MicroBatcher:
+    """Coalesce concurrent predict requests into one device dispatch.
+
+    ``raw_score`` applies to every request of the batcher (requests in one
+    coalesced dispatch must share the output transform).
+    """
+
+    def __init__(self, session, *, max_batch_rows: int = 8192,
+                 max_wait_ms: float = 2.0, raw_score: bool = False,
+                 latency_window: int = 2048) -> None:
+        if max_batch_rows < 1:
+            raise ValueError("max_batch_rows must be >= 1")
+        if max_wait_ms < 0:
+            raise ValueError("max_wait_ms must be >= 0")
+        self._session = session
+        self._max_rows = int(max_batch_rows)
+        self._max_wait = float(max_wait_ms) / 1000.0
+        self._raw = bool(raw_score)
+        self._q: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._lat: deque = deque(maxlen=int(latency_window))
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._worker, name="lgbtpu-serve-batcher", daemon=True)
+        self._thread.start()
+
+    # ---------------------------------------------------------------- submit
+    def submit(self, X) -> Future:
+        """Queue one request; returns a Future resolving to its predictions
+        (same shapes as ``PredictSession.predict``). A 1-D row is treated
+        as a single-row batch."""
+        if self._closed:
+            raise RuntimeError("MicroBatcher is closed")
+        X = np.asarray(X, np.float64)
+        if X.ndim == 1:
+            X = X[None, :]
+        req = _Request(X)
+        telemetry.count("serve/requests")
+        telemetry.count("serve/rows", req.rows)
+        self._q.put(req)
+        telemetry.gauge("serve/queue_depth", self._q.qsize())
+        return req.future
+
+    # ---------------------------------------------------------------- worker
+    def _worker(self) -> None:
+        stop = False
+        while not stop:
+            req = self._q.get()
+            if req is _STOP:
+                break
+            batch = [req]
+            rows = req.rows
+            deadline = req.t0 + self._max_wait
+            while rows < self._max_rows:
+                # requests already queued join for free — draining them
+                # never delays anyone. Only WAITING for company is bounded
+                # by the deadline; otherwise a dispatch slower than
+                # max_wait_ms degenerates every backlog into batches of 1.
+                try:
+                    nxt = self._q.get_nowait()
+                except queue.Empty:
+                    remain = deadline - obs.monotonic()
+                    if remain <= 0:
+                        break
+                    try:
+                        nxt = self._q.get(timeout=remain)
+                    except queue.Empty:
+                        break
+                if nxt is _STOP:
+                    stop = True
+                    break
+                batch.append(nxt)
+                rows += nxt.rows
+            telemetry.gauge("serve/queue_depth", self._q.qsize())
+            self._run_batch(batch)
+        self._drain()
+
+    def _run_batch(self, batch) -> None:
+        telemetry.count("serve/batches")
+        telemetry.count("serve/batch_rows", sum(r.rows for r in batch))
+        try:
+            X = batch[0].X if len(batch) == 1 else \
+                np.concatenate([r.X for r in batch], axis=0)
+            with obs.wall("serve/batch"):
+                pieces = self._session.dispatch(X)
+                # the serve path's one sanctioned device->host sync: pull
+                # the coalesced scores for result delivery
+                host = [np.asarray(s, np.float64)[:r]  # graftlint: disable=host-sync
+                        for s, r in pieces]
+            raw = host[0] if len(host) == 1 else np.concatenate(host)
+            out = self._session.finalize(raw, raw_score=self._raw)
+        except BaseException as exc:
+            for r in batch:
+                if not r.future.done():
+                    r.future.set_exception(exc)
+            return
+        off = 0
+        now = obs.monotonic()
+        for r in batch:
+            r.future.set_result(np.array(out[off:off + r.rows]))
+            off += r.rows
+            dt = now - r.t0
+            self._lat.append(dt)
+            telemetry.add_time("wall/serve/request", dt)
+        self._update_latency_gauges()
+
+    def _update_latency_gauges(self) -> None:
+        if not self._lat:
+            return
+        ms = np.asarray(self._lat, np.float64) * 1000.0
+        telemetry.gauge("serve/latency_p50_ms",
+                        round(float(np.percentile(ms, 50)), 4))
+        telemetry.gauge("serve/latency_p99_ms",
+                        round(float(np.percentile(ms, 99)), 4))
+
+    def latency_stats(self) -> dict:
+        """p50/p99/count over the sliding latency window (seconds)."""
+        lat = sorted(self._lat)
+        if not lat:
+            return {"count": 0, "p50_s": 0.0, "p99_s": 0.0}
+        arr = np.asarray(lat, np.float64)
+        return {"count": len(lat),
+                "p50_s": float(np.percentile(arr, 50)),
+                "p99_s": float(np.percentile(arr, 99))}
+
+    # -------------------------------------------------------------- shutdown
+    def _drain(self) -> None:
+        while True:
+            try:
+                r = self._q.get_nowait()
+            except queue.Empty:
+                return
+            if r is _STOP:
+                continue
+            if not r.future.done():
+                r.future.set_exception(RuntimeError("MicroBatcher closed"))
+
+    def close(self, timeout: Optional[float] = None) -> None:
+        """Stop accepting requests, finish the in-flight batch, fail any
+        still-queued futures, join the worker. Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        self._q.put(_STOP)
+        self._thread.join(timeout)
+
+    def __enter__(self) -> "MicroBatcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,113 @@
+"""Serving throughput/latency benchmark (open + closed loop).
+
+Compares three ways of answering the same request stream:
+
+- **naive**: one ``Booster.predict`` call per request at batch size 1 —
+  the pre-serve baseline (host per-tree walk; per-call overhead dominates);
+- **open loop**: submit every request to a MicroBatcher at once, gather
+  futures — measures coalesced throughput (requests/s, rows/s);
+- **closed loop**: one request in flight at a time — measures per-request
+  latency (p50/p99) including the batcher's ``max_wait_ms`` deadline.
+
+Parity between naive and served predictions is asserted IN-RUN (the bench
+refuses to report a speedup over wrong answers). Timing uses obs.wall;
+warmup (bucket-ladder compilation) happens before any timed section, like
+bench.py excludes one-time setup.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from .. import obs
+from ..obs import telemetry
+
+
+def _make_data(n: int, f: int, seed: int):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = (X[:, 0] + 0.5 * X[:, 1] * np.sin(X[:, 2]) +
+         0.3 * rng.randn(n) > 0).astype(np.float64)
+    return X, y
+
+
+def run_serve_bench(*, requests: int = 512, rows_per_request: int = 1,
+                    trees: int = 120, num_leaves: int = 63,
+                    n_features: int = 28, train_rows: int = 20000,
+                    max_batch_rows: int = 8192, max_wait_ms: float = 2.0,
+                    closed_loop_requests: int = 128,
+                    assert_speedup: Optional[float] = None,
+                    seed: int = 3) -> Dict[str, Any]:
+    """Train a small model, replay a request stream three ways, return a
+    bench-style JSON-serializable dict. With ``assert_speedup``, raises
+    AssertionError when open-loop throughput is below that multiple of the
+    naive baseline."""
+    import lightgbm_tpu as lgb
+
+    X, y = _make_data(train_rows, n_features, seed)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "binary", "num_leaves": num_leaves,
+                     "verbosity": -1, "tpu_iter_block": 20},
+                    ds, num_boost_round=trees)
+
+    rng = np.random.RandomState(seed + 1)
+    pool = rng.randn(requests * rows_per_request, n_features)
+    reqs = [pool[i * rows_per_request:(i + 1) * rows_per_request]
+            for i in range(requests)]
+
+    # -- naive: per-request Booster.predict at batch size 1 (host walk) --
+    with obs.wall("serve_bench/naive") as w:
+        naive = [bst.predict(r) for r in reqs]
+    naive_s = max(w.seconds, 1e-9)
+
+    # -- session + batcher (warmup excluded from every timed section) --
+    session = lgb.serve.PredictSession(bst)
+    session.warmup([rows_per_request, min(max_batch_rows, len(pool))])
+    served = []
+    with lgb.serve.MicroBatcher(session, max_batch_rows=max_batch_rows,
+                                max_wait_ms=max_wait_ms) as mb:
+        with obs.wall("serve_bench/open_loop") as w:
+            futs = [mb.submit(r) for r in reqs]
+            served = [f.result(timeout=120) for f in futs]
+        open_s = max(w.seconds, 1e-9)
+        closed_lat = []
+        for r in reqs[:closed_loop_requests]:
+            t0 = obs.monotonic()
+            mb.submit(r).result(timeout=120)
+            closed_lat.append(obs.monotonic() - t0)
+
+    # -- parity asserted in-run: a fast wrong answer is not a result --
+    flat_naive = np.concatenate([np.atleast_1d(p) for p in naive])
+    flat_served = np.concatenate([np.atleast_1d(p) for p in served])
+    np.testing.assert_allclose(flat_served, flat_naive, rtol=1e-4, atol=1e-5)
+    parity = float(np.max(np.abs(flat_served - flat_naive))) \
+        if len(flat_naive) else 0.0
+
+    total_rows = requests * rows_per_request
+    speedup = naive_s / open_s
+    lat = np.asarray(closed_lat, np.float64) * 1000.0
+    result = {
+        "metric": "serve_open_loop_throughput",
+        "value": round(total_rows / open_s, 2),
+        "unit": "rows/s (%d requests x %d rows, %d trees x %d leaves, "
+                "max_batch_rows=%d max_wait_ms=%g)"
+                % (requests, rows_per_request, trees, num_leaves,
+                   max_batch_rows, max_wait_ms),
+        "vs_baseline": round(speedup, 3),
+        "naive_rows_per_s": round(total_rows / naive_s, 2),
+        "naive_s": round(naive_s, 4),
+        "open_loop_s": round(open_s, 4),
+        "open_loop_requests_per_s": round(requests / open_s, 2),
+        "closed_loop_p50_ms": round(float(np.percentile(lat, 50)), 3),
+        "closed_loop_p99_ms": round(float(np.percentile(lat, 99)), 3),
+        "parity_max_abs_err": parity,
+        "serve_counters": {
+            k: v for k, v in telemetry.snapshot()["counters"].items()
+            if k.startswith("serve/")},
+    }
+    if assert_speedup is not None and speedup < assert_speedup:
+        raise AssertionError(
+            "serve speedup %.2fx below the required %.1fx (naive %.3fs, "
+            "open loop %.3fs)" % (speedup, assert_speedup, naive_s, open_s))
+    return result

@@ -1,0 +1,112 @@
+"""Stdlib-HTTP JSON prediction endpoint (``task=serve`` in the CLI).
+
+    POST /predict   {"rows": [[f0, f1, ...], ...]}
+                    -> {"predictions": [...], "rows": n}
+    GET  /healthz   liveness + model/bucket info
+    GET  /telemetry full obs.Telemetry snapshot (serve/* counters, jit
+                    compile counts, latency gauges)
+
+``ThreadingHTTPServer`` gives one handler thread per connection, so
+concurrent POSTs land in the MicroBatcher together and coalesce into one
+device dispatch. No dependencies beyond the standard library.
+"""
+from __future__ import annotations
+
+import json
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..obs import telemetry
+from ..utils.log import Log
+from .batcher import MicroBatcher
+from .session import PredictSession
+
+
+class PredictServer:
+    """PredictSession + MicroBatcher behind a stdlib HTTP server.
+
+    ``port=0`` binds an ephemeral port (tests); read it back from
+    ``server.address``. ``serve_forever()`` blocks; call ``close()`` (any
+    thread) to stop the server and the batcher worker.
+    """
+
+    def __init__(self, model, *, host: str = "127.0.0.1", port: int = 8080,
+                 max_batch_rows: int = 8192, max_wait_ms: float = 2.0,
+                 buckets: Optional[Sequence[int]] = None,
+                 raw_score: bool = False, warmup: bool = True,
+                 request_timeout_s: float = 30.0) -> None:
+        self.session = PredictSession(model, buckets=buckets)
+        if warmup:
+            self.session.warmup()
+        self.batcher = MicroBatcher(self.session,
+                                    max_batch_rows=max_batch_rows,
+                                    max_wait_ms=max_wait_ms,
+                                    raw_score=raw_score)
+        self.request_timeout_s = float(request_timeout_s)
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # default writes to stderr
+                Log.debug("serve: " + fmt % args)
+
+            def _json(self, code: int, obj) -> None:
+                body = json.dumps(obj).encode("utf-8")
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._json(200, {
+                        "status": "ok",
+                        "model_version": server.session._gbdt.model_version,
+                        "buckets": list(server.session.buckets),
+                        "requests": telemetry.counter("serve/requests"),
+                    })
+                elif self.path == "/telemetry":
+                    self._json(200, telemetry.snapshot())
+                else:
+                    self._json(404, {"error": "unknown path %s" % self.path})
+
+            def do_POST(self):
+                if self.path != "/predict":
+                    self._json(404, {"error": "unknown path %s" % self.path})
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length") or 0)
+                    payload = json.loads(self.rfile.read(length) or b"{}")
+                    rows = payload["rows"]
+                    X = np.asarray(rows, np.float64)
+                    if X.ndim == 1:
+                        X = X[None, :]
+                    fut = server.batcher.submit(X)
+                    out = fut.result(timeout=server.request_timeout_s)
+                    self._json(200, {"predictions": out.tolist(),
+                                     "rows": int(X.shape[0])})
+                except Exception as exc:
+                    self._json(400, {"error": "%s: %s"
+                                     % (type(exc).__name__, exc)})
+
+        self.httpd = ThreadingHTTPServer((host, int(port)), Handler)
+
+    @property
+    def address(self):
+        """(host, port) actually bound — resolves port=0 ephemeral binds."""
+        return self.httpd.server_address[:2]
+
+    def serve_forever(self) -> None:
+        self.httpd.serve_forever()
+
+    def shutdown(self) -> None:
+        """Unblock serve_forever() (callable from any thread)."""
+        self.httpd.shutdown()
+
+    def close(self) -> None:
+        try:
+            self.httpd.server_close()
+        finally:
+            self.batcher.close()

@@ -1,0 +1,70 @@
+"""Serving benchmark CLI: session+batcher vs naive per-request predict.
+
+Usage:
+    python scripts/serve_bench.py --quick       # CPU-sized run, ~seconds
+    python scripts/serve_bench.py               # full-sized run
+    python scripts/serve_bench.py --no-assert   # report without the >=5x gate
+
+Prints ONE JSON line (bench.py style): open-loop rows/s as the headline
+metric, vs_baseline = speedup over the naive loop, closed-loop p50/p99
+latency, the in-run parity error, and the serve/* telemetry counters.
+Exits non-zero when the speedup gate fails (parity is always asserted).
+"""
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="serve_bench", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--quick", action="store_true",
+                    help="small CPU-friendly workload (CI / laptops)")
+    ap.add_argument("--requests", type=int, default=None)
+    ap.add_argument("--rows-per-request", type=int, default=1)
+    ap.add_argument("--trees", type=int, default=None)
+    ap.add_argument("--leaves", type=int, default=None)
+    ap.add_argument("--features", type=int, default=None)
+    ap.add_argument("--max-batch-rows", type=int, default=8192)
+    ap.add_argument("--max-wait-ms", type=float, default=2.0)
+    ap.add_argument("--no-assert", action="store_true",
+                    help="report the speedup without gating on >=5x")
+    args = ap.parse_args(argv)
+
+    if args.quick:
+        preset = dict(requests=96, trees=30, num_leaves=15, n_features=12,
+                      train_rows=4000, closed_loop_requests=48)
+    else:
+        preset = dict(requests=512, trees=120, num_leaves=63, n_features=28,
+                      train_rows=20000, closed_loop_requests=128)
+    if args.requests is not None:
+        preset["requests"] = args.requests
+    if args.trees is not None:
+        preset["trees"] = args.trees
+    if args.leaves is not None:
+        preset["num_leaves"] = args.leaves
+    if args.features is not None:
+        preset["n_features"] = args.features
+
+    from lightgbm_tpu.serve.bench import run_serve_bench
+    try:
+        result = run_serve_bench(
+            rows_per_request=args.rows_per_request,
+            max_batch_rows=args.max_batch_rows,
+            max_wait_ms=args.max_wait_ms,
+            assert_speedup=None if args.no_assert else 5.0,
+            **preset)
+    except AssertionError as exc:
+        print(json.dumps({"error": str(exc)}))
+        return 1
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -111,6 +111,48 @@ def test_second_identical_train_compiles_nothing():
     assert jc["backend_compiles"] == 0, jc
 
 
+# ---------------------------------------------------------- serving budgets
+
+def test_second_same_bucket_predict_zero_compiles():
+    """The serving contract: once a bucket is warm, repeat predicts in that
+    bucket pay ZERO tracked compiles, ZERO backend compiles, and ZERO host
+    re-packs — regardless of the exact row count within the bucket."""
+    from lightgbm_tpu.serve import PredictSession
+    X, y = _data(n=1000)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train(dict(PARAMS), ds, num_boost_round=5)
+    sess = PredictSession(bst, buckets=(1024,))
+    sess.predict(X[:600], raw_score=True)    # warm: pack upload + compile
+    obs.telemetry.reset()
+    sess.predict(X[:600], raw_score=True)    # same bucket, same N
+    sess.predict(X[:600], raw_score=True)
+    sess.predict(X[:1000], raw_score=True)   # same bucket, different N
+    jc = obs.telemetry.snapshot()["jit_compiles"]
+    assert jc["total"] == 0, jc
+    assert jc["backend_compiles"] == 0, jc
+    assert obs.telemetry.counter("serve/pack_build") == 0
+    assert obs.telemetry.counter("serve/bucket_hit") == 3
+
+
+def test_warmup_ladder_compile_budget():
+    """warmup() pre-compiles the ladder: at most one predict compile per
+    rung, and a second warmup compiles nothing new."""
+    from lightgbm_tpu.serve import PredictSession
+    X, y = _data()
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train(dict(PARAMS), ds, num_boost_round=5)
+    rungs = (96, 192, 384)
+    sess = PredictSession(bst, buckets=rungs)
+    obs.telemetry.reset()
+    sess.warmup()
+    jc = obs.telemetry.snapshot()["jit_compiles"]["per_function"]
+    assert jc.get("serve/predict_bucket", 0) <= len(rungs), jc
+    obs.telemetry.reset()
+    sess.warmup()
+    jc = obs.telemetry.snapshot()["jit_compiles"]
+    assert jc["total"] == 0, jc
+
+
 def test_bench_json_carries_jit_compiles():
     """bench.py embeds telemetry.snapshot(); the jit_compiles section must
     be json-serializable and present."""

@@ -1,0 +1,277 @@
+"""Serving subsystem tests (ISSUE 5): PredictSession / MicroBatcher /
+PredictServer parity, pad-slice exactness, batching semantics.
+
+Parity baseline is the per-tree HOST walk (Tree.predict in float64). The
+device path accumulates in float32, so session-vs-host parity is asserted
+to tight tolerances; what IS exact is everything the serve layer itself
+adds — padding to a bucket then slicing back, and batcher-vs-session
+(same compiled program over row-independent routing) — and those are
+asserted bit-identical.
+"""
+import os
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax.numpy as jnp  # noqa: E402
+
+import lightgbm_tpu as lgb  # noqa: E402
+from lightgbm_tpu import obs  # noqa: E402
+from lightgbm_tpu.serve import (  # noqa: E402
+    MicroBatcher,
+    PredictServer,
+    PredictSession,
+)
+
+TOL = dict(rtol=1e-5, atol=1e-6)
+
+
+def _data(n=700, f=10, seed=0, nan_frac=0.0, cat=False, classes=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    if cat:
+        X[:, 0] = rng.randint(0, 6, size=n)
+    if classes:
+        y = (np.digitize(X[:, 1], [-0.5, 0.5])).astype(np.float64)
+    else:
+        y = (X[:, 1] + 0.25 * rng.randn(n) > 0).astype(np.float64)
+    if nan_frac:
+        mask = rng.rand(n, f) < nan_frac
+        mask[:, 0] = False if cat else mask[:, 0]
+        X[mask] = np.nan
+    return X, y
+
+
+def _train(X, y, extra=None, rounds=12):
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "tpu_iter_block": 4}
+    params.update(extra or {})
+    ds = lgb.Dataset(X, label=y,
+                     categorical_feature=params.pop("categorical_feature", []))
+    return lgb.train(params, ds, num_boost_round=rounds), ds
+
+
+def _host_predict(bst, X, raw=False):
+    """Per-tree host walk reference (float64 end to end except the shared
+    output transform)."""
+    g = bst.inner
+    K = g.num_tree_per_iteration
+    score = np.zeros((len(X), K), np.float64)
+    for i, t in enumerate(g.models):
+        score[:, i % K] += t.predict(X)
+    score = score + g.init_scores[None, :K]
+    if not raw and g.objective is not None:
+        score = np.asarray(g.objective.convert_output(jnp.asarray(score)))
+    return score.ravel() if K == 1 else score
+
+
+# ------------------------------------------------------------------- parity
+
+def test_session_parity_nan_missing_rows():
+    X, y = _data(nan_frac=0.15, seed=1)
+    bst, _ = _train(X, y)
+    sess = PredictSession(bst)
+    np.testing.assert_allclose(sess.predict(X), _host_predict(bst, X), **TOL)
+    np.testing.assert_allclose(sess.predict(X, raw_score=True),
+                               _host_predict(bst, X, raw=True), **TOL)
+
+
+def test_session_parity_multiclass():
+    X, y = _data(seed=2, classes=3)
+    bst, _ = _train(X, y, {"objective": "multiclass", "num_class": 3})
+    sess = PredictSession(bst)
+    out = sess.predict(X)
+    assert out.shape == (len(X), 3)
+    np.testing.assert_allclose(out, _host_predict(bst, X), **TOL)
+
+
+def test_session_parity_categorical():
+    X, y = _data(seed=3, cat=True)
+    bst, _ = _train(X, y, {"categorical_feature": [0]})
+    sess = PredictSession(bst)
+    np.testing.assert_allclose(sess.predict(X), _host_predict(bst, X), **TOL)
+
+
+def test_session_matches_booster_device_path():
+    """Booster.predict >= DEVICE_PREDICT_MIN_ROWS rows routes through the
+    session — same numbers as a standalone session over the same model."""
+    X, y = _data(n=900, seed=4)
+    bst, _ = _train(X, y)
+    sess = PredictSession(bst)
+    np.testing.assert_array_equal(sess.predict(X), bst.predict(X))
+
+
+# -------------------------------------------------------- pad/slice + buckets
+
+def test_pad_slice_exact_non_bucket_aligned():
+    """Rows are routed independently, so padding to the bucket and slicing
+    back must be EXACT: an unaligned-N predict equals the same rows from a
+    full-bucket predict, bit for bit."""
+    X, y = _data(n=640, seed=5)
+    bst, _ = _train(X, y)
+    sess = PredictSession(bst, buckets=(256, 640))
+    full = sess.predict(X[:256])          # exactly one bucket, no padding
+    part = sess.predict(X[:77])           # 77 -> padded to 256
+    np.testing.assert_array_equal(part, full[:77])
+    a = sess.predict(X[:300], raw_score=True)   # 300 -> bucket 640
+    b = sess.predict(X[:640], raw_score=True)   # exactly the 640 bucket
+    np.testing.assert_array_equal(a, b[:300])
+
+
+def test_bucket_ladder_and_chunking():
+    X, y = _data(n=900, seed=6)
+    bst, _ = _train(X, y)
+    sess = PredictSession(bst, buckets=(128, 256))
+    assert sess.bucket_for(1) == 128
+    assert sess.bucket_for(129) == 256
+    assert sess.bucket_for(10_000) == 256   # beyond the ladder: top rung
+    # 900 rows over a 256-top ladder -> 4 chunks, still correct
+    np.testing.assert_allclose(sess.predict(X), _host_predict(bst, X), **TOL)
+
+
+# --------------------------------------------------------------- micro-batch
+
+def test_batcher_bit_identical_to_session():
+    X, y = _data(n=600, seed=7)
+    bst, _ = _train(X, y)
+    sess = PredictSession(bst, buckets=(64, 256))
+    base = sess.predict(X[:64])           # one full bucket, no padding
+    results = {}
+    with MicroBatcher(sess, max_batch_rows=64, max_wait_ms=20.0) as mb:
+        def post(i):
+            results[i] = mb.submit(X[i:i + 1]).result(timeout=60)
+        threads = [threading.Thread(target=post, args=(i,)) for i in range(64)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    got = np.concatenate([results[i] for i in range(64)])
+    np.testing.assert_array_equal(got, base)
+    assert obs.telemetry.counter("serve/batches") >= 1
+
+
+def test_batcher_coalesces_into_few_batches():
+    X, y = _data(n=600, seed=8)
+    bst, _ = _train(X, y)
+    sess = PredictSession(bst, buckets=(256,))
+    sess.warmup([1])
+    before = obs.telemetry.counter("serve/batches")
+    with MicroBatcher(sess, max_batch_rows=256, max_wait_ms=50.0) as mb:
+        futs = [mb.submit(X[i:i + 1]) for i in range(40)]
+        outs = [f.result(timeout=60) for f in futs]
+    batches = obs.telemetry.counter("serve/batches") - before
+    assert 1 <= batches < 40, "40 submits should coalesce, got %d" % batches
+    np.testing.assert_array_equal(np.concatenate(outs), sess.predict(X[:40]))
+
+
+def test_batcher_propagates_worker_exceptions():
+    X, y = _data(seed=9)
+    bst, _ = _train(X, y)
+    sess = PredictSession(bst)
+    with MicroBatcher(sess) as mb:
+        fut = mb.submit(np.zeros((2, 2, 2)))   # 3-D batch: dispatch raises
+        with pytest.raises(Exception):
+            fut.result(timeout=60)
+        # worker survives the failed batch and keeps serving
+        ok = mb.submit(X[:1]).result(timeout=60)
+        assert ok.shape == (1,)
+
+
+def test_batcher_close_is_clean_and_idempotent():
+    X, y = _data(seed=10)
+    bst, _ = _train(X, y)
+    sess = PredictSession(bst)
+    mb = MicroBatcher(sess)
+    assert mb.submit(X[:3]).result(timeout=60).shape == (3,)
+    mb.close()
+    mb.close()
+    with pytest.raises(RuntimeError):
+        mb.submit(X[:1])
+    assert not mb._thread.is_alive()
+
+
+# ------------------------------------------------------------ binned fast path
+
+def test_binned_fast_path_matches_raw_routing():
+    X, y = _data(seed=11)
+    bst, ds = _train(X, y)
+    sess = PredictSession(bst)
+    binned = sess.predict_binned(ds)
+    np.testing.assert_allclose(binned, sess.predict(X), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(binned, _host_predict(bst, X), rtol=1e-4,
+                               atol=1e-5)
+
+
+# ------------------------------------------------------- model-version safety
+
+def test_session_tracks_model_updates_and_rollback():
+    X, y = _data(n=600, seed=12)
+    bst, _ = _train(X, y, rounds=6)
+    sess = PredictSession(bst)
+    np.testing.assert_allclose(sess.predict(X), _host_predict(bst, X), **TOL)
+    bst.update()                      # continued training -> version bump
+    np.testing.assert_allclose(sess.predict(X), _host_predict(bst, X), **TOL)
+    bst.inner.rollback_one_iter()     # rollback -> version bump
+    np.testing.assert_allclose(sess.predict(X), _host_predict(bst, X), **TOL)
+
+
+# ------------------------------------------------------------------ HTTP API
+
+def test_http_server_roundtrip():
+    import json
+    from urllib.request import Request, urlopen
+
+    X, y = _data(seed=13)
+    bst, _ = _train(X, y)
+    server = PredictServer(bst, port=0, buckets=(64,), warmup=True,
+                           max_wait_ms=1.0)
+    host, port = server.address
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        body = json.dumps({"rows": X[:5].tolist()}).encode()
+        req = Request("http://%s:%d/predict" % (host, port), data=body,
+                      headers={"Content-Type": "application/json"})
+        with urlopen(req, timeout=30) as resp:
+            out = json.loads(resp.read())
+        np.testing.assert_allclose(np.asarray(out["predictions"]),
+                                   _host_predict(bst, X[:5]), **TOL)
+        assert out["rows"] == 5
+        with urlopen("http://%s:%d/healthz" % (host, port), timeout=30) as r:
+            health = json.loads(r.read())
+        assert health["status"] == "ok"
+        with urlopen("http://%s:%d/telemetry" % (host, port), timeout=30) as r:
+            snap = json.loads(r.read())
+        assert snap["counters"].get("serve/requests", 0) >= 1
+    finally:
+        server.shutdown()
+        thread.join(timeout=10)
+        server.close()
+
+
+# ------------------------------------------------------------------ counters
+
+def test_serve_counters_and_latency_gauges():
+    X, y = _data(seed=14)
+    bst, _ = _train(X, y)
+    obs.telemetry.reset()
+    sess = PredictSession(bst, buckets=(64,))
+    sess.predict(X[:10])
+    with MicroBatcher(sess, max_wait_ms=1.0) as mb:
+        mb.submit(X[:7]).result(timeout=60)
+    snap = obs.telemetry.snapshot()
+    c = snap["counters"]
+    assert c["serve/requests"] == 2
+    assert c["serve/rows"] == 17
+    assert c["serve/pack_build"] == 1
+    assert c["serve/batches"] == 1
+    assert c["serve/dispatches"] >= 2
+    assert "serve/queue_depth" in snap["gauges"]
+    assert "serve/latency_p50_ms" in snap["gauges"]
+    assert "serve/latency_p99_ms" in snap["gauges"]
+    assert snap["timers"].get("wall/serve/request", 0) > 0
