@@ -10,6 +10,7 @@ prediction routing — is jitted device code. Scores are float32 device arrays
 from __future__ import annotations
 
 import json
+import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -99,6 +100,12 @@ class GBDT:
         # prediction packs key on it (an (len, id(tree)) key is unsafe:
         # rollback + retrain can reproduce both with different trees)
         self._model_version = 0
+        # guards models mutations, the version token and the serving
+        # caches (_pack_cache/_serve_sessions/_tree_log_cache): a
+        # PredictSession worker thread must never pack a half-committed
+        # model. Re-entrant because _rebuild_scores bumps the version
+        # from inside locked sections.
+        self._cache_lock = threading.RLock()
         if train_set is not None:
             self._setup(train_set)
 
@@ -180,19 +187,24 @@ class GBDT:
         # logs are cached per (tree state, dataset): DART re-drops the same
         # trees every iteration and each conversion costs host work plus
         # ~a dozen host->device uploads
-        cache = getattr(self, "_tree_log_cache", None)
-        if cache is None:
-            cache = self._tree_log_cache = {}
         # content key (not id()): a GC'd tree's address can be reused by a
         # new tree with byte-identical leaf values after rollback
         key = (tree.num_leaves, tree.split_feature.tobytes(),
                tree.threshold.tobytes(), tree.decision_type.tobytes(),
                tree.leaf_value.tobytes(), id(ds))
-        log = cache.get(key)
+        with self._cache_lock:
+            cache = getattr(self, "_tree_log_cache", None)
+            if cache is None:
+                cache = self._tree_log_cache = {}
+            log = cache.get(key)
         if log is None:
-            if len(cache) > 4096:
-                cache.clear()
-            log = cache[key] = tree_to_bin_log(tree, ds)
+            # convert outside the lock (host work + uploads); a racing
+            # duplicate conversion is harmless, a held lock is not
+            log = tree_to_bin_log(tree, ds)
+            with self._cache_lock:
+                if len(cache) > 4096:
+                    cache.clear()
+                cache[key] = log
         if ds is self.train_set and self.learner is not None:
             bins = self.learner.bins
             bundle = self.learner.bundle
@@ -294,7 +306,8 @@ class GBDT:
             log = self.learner.train(ghc, fmask, key,
                                      jnp.asarray(self._cegb_used))
             tree = self._finalize_tree(log, k)
-            self.models.append(tree)
+            with self._cache_lock:
+                self.models.append(tree)
             self._note_used_features(tree)
             # eager-path growth counters (fused blocks count in _count_trees)
             from .obs import telemetry
@@ -320,8 +333,9 @@ class GBDT:
                                 spec["hist_bytes_per_row"])
             if tree.num_leaves > 1:
                 any_nonconstant = True
-        self.iter_ += 1
-        self._bump_model_version()
+        with self._cache_lock:
+            self.iter_ += 1
+            self._bump_model_version()
         return not any_nonconstant
 
     def _note_used_features(self, tree: Tree) -> None:
@@ -504,11 +518,12 @@ class GBDT:
         self.finish_fused("rollback_one_iter")
         if self.iter_ <= 0:
             return
-        for _ in range(self.num_tree_per_iteration):
-            tree = self.models.pop()
-            del tree
-        self.iter_ -= 1
-        self._bump_model_version()
+        with self._cache_lock:
+            for _ in range(self.num_tree_per_iteration):
+                tree = self.models.pop()
+                del tree
+            self.iter_ -= 1
+            self._bump_model_version()
         # scores must be rebuilt; mark dirty and recompute lazily
         self._rebuild_scores()
 
@@ -580,7 +595,8 @@ class GBDT:
         return self._model_version
 
     def _bump_model_version(self) -> None:
-        self._model_version += 1
+        with self._cache_lock:
+            self._model_version += 1
 
     def _packed_model(self, start: int, end: int):
         """Device-resident ``PackedSplits`` for iterations [start, end).
@@ -593,21 +609,25 @@ class GBDT:
         from .obs import telemetry
         from .ops.predict import pack_splits
 
-        cache = getattr(self, "_pack_cache", None)
-        if cache is None or not isinstance(cache, dict):
-            cache = self._pack_cache = {}
-        key = (start, end, self._model_version)
-        hit = cache.get(key)
-        if hit is not None:
-            telemetry.count("serve/pack_hit")
+        # the whole lookup-or-build runs under the model lock: the key
+        # read, the models slice and the store must see one consistent
+        # (models, version) pair or a concurrent commit tears the pack
+        with self._cache_lock:
+            cache = getattr(self, "_pack_cache", None)
+            if cache is None or not isinstance(cache, dict):
+                cache = self._pack_cache = {}
+            key = (start, end, self._model_version)
+            hit = cache.get(key)
+            if hit is not None:
+                telemetry.count("serve/pack_hit")
+                return hit
+            if len(cache) > 16:
+                cache.clear()
+            telemetry.count("serve/pack_build")
+            K = self.num_tree_per_iteration
+            hit = cache[key] = pack_splits(self.models[start * K:end * K],
+                                           num_class=K)
             return hit
-        if len(cache) > 16:
-            cache.clear()
-        telemetry.count("serve/pack_build")
-        K = self.num_tree_per_iteration
-        hit = cache[key] = pack_splits(self.models[start * K:end * K],
-                                       num_class=K)
-        return hit
 
     def _predict_session(self, start: int, end: int):
         """Lazily created serving session per iteration range (the device
@@ -616,16 +636,17 @@ class GBDT:
         ``_packed_model`` cache."""
         from .serve.session import PredictSession
 
-        cache = getattr(self, "_serve_sessions", None)
-        if cache is None:
-            cache = self._serve_sessions = {}
-        sess = cache.get((start, end))
-        if sess is None:
-            if len(cache) > 32:
-                cache.clear()
-            sess = cache[(start, end)] = PredictSession(
-                self, start_iteration=start, num_iteration=end - start)
-        return sess
+        with self._cache_lock:
+            cache = getattr(self, "_serve_sessions", None)
+            if cache is None:
+                cache = self._serve_sessions = {}
+            sess = cache.get((start, end))
+            if sess is None:
+                if len(cache) > 32:
+                    cache.clear()
+                sess = cache[(start, end)] = PredictSession(
+                    self, start_iteration=start, num_iteration=end - start)
+            return sess
 
     def _raw_scores(self, X: np.ndarray, start: int, end: int) -> np.ndarray:
         """Ensemble raw scores with optional prediction early stopping
@@ -983,24 +1004,27 @@ class DART(GBDT):
             norm = 1.0 / (k_cnt + 1.0)
             if cfg.xgboost_dart_mode:
                 norm = cfg.learning_rate / (k_cnt + cfg.learning_rate)
-            for k in range(K):
-                tree = self.models[-K + k]
-                # remove the freshly-added (unnormalized) contribution, rescale
-                self._apply_tree_delta(tree, k, norm - 1.0)
-                tree.apply_shrinkage(norm)
-            if k_cnt > 0:
-                factor = k_cnt / (k_cnt + 1.0)
-                if cfg.xgboost_dart_mode:
-                    factor = k_cnt / (k_cnt + cfg.learning_rate)
-                for it_idx in drop:
-                    for k in range(K):
-                        tree = self.models[it_idx * K + k]
-                        self._apply_tree_delta(tree, k, factor)
-                        tree.apply_shrinkage(factor)
             # normalization mutates committed trees in place AFTER the
-            # super() bump — bump again so predict packs never serve stale
-            # pre-normalization leaf values
-            self._bump_model_version()
+            # super() bump — run it (and the re-bump) under the model
+            # lock so a concurrent pack never captures half-rescaled
+            # leaf values, then bump so stale packs invalidate
+            with self._cache_lock:
+                for k in range(K):
+                    tree = self.models[-K + k]
+                    # remove the freshly-added (unnormalized)
+                    # contribution, rescale
+                    self._apply_tree_delta(tree, k, norm - 1.0)
+                    tree.apply_shrinkage(norm)
+                if k_cnt > 0:
+                    factor = k_cnt / (k_cnt + 1.0)
+                    if cfg.xgboost_dart_mode:
+                        factor = k_cnt / (k_cnt + cfg.learning_rate)
+                    for it_idx in drop:
+                        for k in range(K):
+                            tree = self.models[it_idx * K + k]
+                            self._apply_tree_delta(tree, k, factor)
+                            tree.apply_shrinkage(factor)
+                self._bump_model_version()
         return stop
 
     def _shrinkage_rate(self, log: TreeLog) -> float:
@@ -1048,13 +1072,15 @@ class RF(GBDT):
                                      jnp.asarray(self._cegb_used))
             tree = self.learner.log_to_tree(log)
             # averaged score: rescale previous sum then add (ref rf.hpp)
-            self.models.append(tree)
+            with self._cache_lock:
+                self.models.append(tree)
             self._note_used_features(tree)
             self._accumulate_avg(tree, log, k)
             if tree.num_leaves > 1:
                 any_ok = True
-        self.iter_ += 1
-        self._bump_model_version()
+        with self._cache_lock:
+            self.iter_ += 1
+            self._bump_model_version()
         return not any_ok
 
     def _accumulate_avg(self, tree: Tree, log: TreeLog, class_id: int) -> None:

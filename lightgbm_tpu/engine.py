@@ -49,13 +49,16 @@ def train(
     if init_model is not None:
         init = init_model if isinstance(init_model, Booster) else \
             Booster(model_file=init_model)
-        # continued training: preload trees + scores
+        # continued training: preload trees + scores. The swap runs under
+        # the model lock — a serving session over this booster must never
+        # pack a models list that is mid-replacement.
         base = init.model_to_string()
         from .boosting import GBDT
         prev = GBDT.model_from_string(base)
-        booster.inner.models = prev.models
-        booster.inner.init_scores = prev.init_scores
-        booster.inner.iter_ = prev.iter_
+        with booster.inner._cache_lock:
+            booster.inner.models = prev.models
+            booster.inner.init_scores = prev.init_scores
+            booster.inner.iter_ = prev.iter_
         booster.inner._rebuild_scores()
 
     valid_sets = valid_sets or []

@@ -532,10 +532,12 @@ class FusedTrainer:
         except BaseException:
             self._rollback(pre_score, pre_used)
             raise
-        # atomic commit: models/iter_ move together only on full success
-        gbdt.models.extend(trees)
-        gbdt.iter_ += k
-        gbdt._bump_model_version()
+        # atomic commit: models/iter_/version move together only on full
+        # success, under the model lock so serving never packs mid-commit
+        with gbdt._cache_lock:
+            gbdt.models.extend(trees)
+            gbdt.iter_ += k
+            gbdt._bump_model_version()
         self._count_trees(trees)
         return last_iter_constant
 

@@ -72,6 +72,16 @@ class SourceFile:
         except SyntaxError as e:  # pragma: no cover - repo parses today
             self.parse_error = e
 
+    def walk_nodes(self) -> list:
+        """Every AST node of this file, cached: five per-file rules scan
+        the full tree, and one materialized list beats five generator
+        walks inside the <5s full-lint budget."""
+        nodes = self.__dict__.get("_walk_nodes")
+        if nodes is None:
+            nodes = self._walk_nodes = \
+                list(ast.walk(self.tree)) if self.tree is not None else []
+        return nodes
+
     def line_text(self, lineno: int) -> str:
         if 1 <= lineno <= len(self.lines):
             return self.lines[lineno - 1].strip()

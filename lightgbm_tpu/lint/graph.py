@@ -1,0 +1,608 @@
+"""Interprocedural dataflow engine for graftlint (ISSUE 6 tentpole).
+
+PR 4's ``HostSyncRule`` carried a private lexically-scoped call-graph
+builder; the hazards that matter after PR 5 (a daemon batcher thread,
+stdlib-HTTP handler threads, version-keyed caches shared between a
+training thread and serving threads) are interprocedural and span
+packages, so the resolver now lives here as a reusable engine:
+
+- a project-wide **symbol table**: every function/method with its lexical
+  position (enclosing function, enclosing class, file top-level), every
+  class with its bases and methods;
+- a small **type lattice** (abstract values are sets of project class
+  quals plus ``ext:<module.Name>`` markers for external constructors)
+  propagated to fixpoint through local assignments, ``self.attr =``
+  writes, call-site parameter binding and return values — enough to
+  resolve ``self._session.dispatch(...)`` to ``PredictSession.dispatch``
+  instead of every method named ``dispatch``;
+- a **call graph** over bare-name calls (innermost lexical scope first,
+  never methods), attribute calls (typed receiver first, falling back to
+  by-name method matching, suppressed for known-external receivers) and
+  function-valued arguments (``lax.while_loop``/``scan``/``vmap`` bodies,
+  ``partial``-wrapped jit entries);
+- **entry discovery**: jit entries (decorators plus functions handed by
+  value to ``jax.jit``/``partial``) and thread entries
+  (``threading.Thread(target=...)``/``Timer``, ``concurrent.futures``
+  ``submit``, and ``do_*`` methods of ``BaseHTTPRequestHandler``
+  subclasses);
+- **reachability** closures over the above.
+
+Pure stdlib + ``ast``; importing this module must never import jax. Built
+once per (lint run, file subset) and cached on the :class:`~.core.Project`
+(see :func:`graph_for`).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple
+
+from .astutil import (call_name_args, canonical_call, dotted,
+                      import_aliases_cached, own_walk)
+
+#: jit / partial wrapper heads (entries by value)
+JIT_HEADS = {"jax.jit", "jit"}
+PARTIAL_HEADS = {"partial", "functools.partial", "_partial"}
+
+#: constructors whose result is a freshly built, not-yet-shared object
+#: (writes through such locals are construction, not mutation)
+_THREAD_CTORS = {"threading.Thread", "threading.Timer"}
+
+EXT = "ext:"  # type-tag prefix for external (non-project) constructor types
+
+#: bare-name constructors of builtin/stdlib containers and scalars: typing
+#: their results ``ext:`` suppresses the by-name method fallback, so
+#: ``self._warm.add(x)`` on a set never resolves to a project ``add``
+_BUILTIN_CTORS = {"set", "dict", "list", "tuple", "frozenset", "bytearray",
+                  "bytes", "str", "int", "float", "bool", "object",
+                  "complex"}
+
+
+class FuncInfo:
+    """One function/method with its lexical position in the project."""
+
+    __slots__ = ("node", "file", "qual", "name", "parent", "cls",
+                 "children", "edges", "is_method")
+
+    def __init__(self, node, file, qual: str, parent: Optional["FuncInfo"],
+                 cls: Optional["ClassInfo"]) -> None:
+        self.node = node
+        self.file = file
+        self.qual = qual
+        self.name = node.name
+        self.parent = parent
+        self.cls = cls
+        self.is_method = cls is not None
+        self.children: Dict[str, List["FuncInfo"]] = {}
+        self.edges: List["FuncInfo"] = []
+
+    @property
+    def self_name(self) -> Optional[str]:
+        """The receiver parameter name ('self') for instance methods."""
+        if not self.is_method:
+            return None
+        if any(dotted(d) == "staticmethod" for d in self.node.decorator_list):
+            return None
+        args = self.node.args.posonlyargs + self.node.args.args
+        return args[0].arg if args else None
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return "<fn %s:%s>" % (self.file.rel, self.qual)
+
+
+class ClassInfo:
+    """One class with its bases (dotted names) and directly-defined
+    methods."""
+
+    __slots__ = ("node", "file", "qual", "name", "bases", "methods", "parent")
+
+    def __init__(self, node, file, qual: str,
+                 parent: Optional[FuncInfo]) -> None:
+        self.node = node
+        self.file = file
+        self.qual = qual
+        self.name = node.name
+        self.bases = [dotted(b) for b in node.bases]
+        self.methods: Dict[str, FuncInfo] = {}
+        self.parent = parent
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return "<class %s:%s>" % (self.file.rel, self.qual)
+
+
+def is_jit_decorator(dec: ast.AST) -> bool:
+    name = dotted(dec)
+    if name in JIT_HEADS or name.endswith(".jit"):
+        return True
+    if isinstance(dec, ast.Call):
+        fname = dotted(dec.func)
+        if fname in JIT_HEADS or fname.endswith(".jit"):
+            return True
+        if fname in PARTIAL_HEADS or fname.endswith(".partial"):
+            return any(dotted(a) in JIT_HEADS or dotted(a).endswith(".jit")
+                       for a in dec.args)
+    return False
+
+
+class ProjectGraph:
+    """Symbol table + types + call graph over one file subset."""
+
+    def __init__(self, files: Sequence) -> None:
+        self.files = [f for f in files if f.tree is not None]
+        self.funcs: List[FuncInfo] = []
+        self.classes: List[ClassInfo] = []
+        self.classes_by_name: Dict[str, List[ClassInfo]] = {}
+        self.methods_by_name: Dict[str, List[FuncInfo]] = {}
+        self.top_level: Dict[str, Dict[str, List[FuncInfo]]] = {}
+        self.aliases: Dict[str, Dict[str, str]] = {}
+        # dataflow facts (fixpoint-iterated)
+        self.attr_types: Dict[Tuple[str, str], Set[str]] = {}
+        self.param_types: Dict[Tuple[int, str], Set[str]] = {}
+        self.return_types: Dict[int, Set[str]] = {}
+        self.global_types: Dict[Tuple[str, str], Set[str]] = {}
+        #: attr name -> class quals that assign ``self.<attr> =`` anywhere
+        self.attr_owners: Dict[str, Set[str]] = {}
+        self._value_entries: List[FuncInfo] = []
+        self._collect()
+        self._extract_facts()
+        self._infer_types()
+        self._build_edges()
+
+    # ----------------------------------------------------------- collection
+    def _collect(self) -> None:
+        for f in self.files:
+            self.aliases[f.rel] = import_aliases_cached(f)
+            self.top_level.setdefault(f.rel, {})
+            self._walk_block(f, f.tree, "", None, None)
+
+    def _walk_block(self, f, parent, prefix: str, encl: Optional[FuncInfo],
+                    cls: Optional[ClassInfo]) -> None:
+        for node in ast.iter_child_nodes(parent):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                info = FuncInfo(node, f, prefix + node.name, encl, cls)
+                self.funcs.append(info)
+                if cls is not None:
+                    cls.methods.setdefault(node.name, info)
+                    self.methods_by_name.setdefault(node.name, []).append(info)
+                elif encl is None:
+                    self.top_level[f.rel].setdefault(node.name, []).append(info)
+                else:
+                    encl.children.setdefault(node.name, []).append(info)
+                self._walk_block(f, node, info.qual + ".", info, None)
+            elif isinstance(node, ast.ClassDef):
+                ci = ClassInfo(node, f, prefix + node.name, encl)
+                self.classes.append(ci)
+                self.classes_by_name.setdefault(node.name, []).append(ci)
+                self._walk_block(f, node, ci.qual + ".", encl, ci)
+            else:
+                self._walk_block(f, node, prefix, encl, cls)
+
+    # ----------------------------------------------------------- resolution
+    def resolve_bare(self, ctx: Optional[FuncInfo], rel: str,
+                     name: str) -> List[FuncInfo]:
+        """Bare-name call resolution: innermost lexical scope outward, then
+        file top-level, then project top-level. Never resolves to methods
+        (the FusedTrainer.flush false-positive class, PR 4)."""
+        cur = ctx
+        while cur is not None:
+            if name in cur.children:
+                return cur.children[name]
+            cur = cur.parent
+        if name in self.top_level.get(rel, {}):
+            return self.top_level[rel][name]
+        out: List[FuncInfo] = []
+        for tl in self.top_level.values():
+            out.extend(tl.get(name, []))
+        return out
+
+    def resolve_class(self, rel: str, name: str) -> List[ClassInfo]:
+        """A (possibly dotted/aliased) name to project classes, matching on
+        the final segment."""
+        tail = self.aliases.get(rel, {}).get(name, name).rsplit(".", 1)[-1]
+        return self.classes_by_name.get(tail, [])
+
+    def class_method(self, ci: ClassInfo, name: str,
+                     _depth: int = 0) -> Optional[FuncInfo]:
+        """Method lookup through project-local bases (bounded depth)."""
+        if name in ci.methods:
+            return ci.methods[name]
+        if _depth >= 4:
+            return None
+        for b in ci.bases:
+            for bc in self.classes_by_name.get(b.rsplit(".", 1)[-1], []):
+                m = self.class_method(bc, name, _depth + 1)
+                if m is not None:
+                    return m
+        return None
+
+    def _class_by_qual(self, qual: str) -> Optional[ClassInfo]:
+        for ci in self.classes_by_name.get(qual.rsplit(".", 1)[-1], []):
+            if ci.qual == qual:
+                return ci
+        return None
+
+    # ------------------------------------------------------- type inference
+    def expr_type(self, owner: Optional[FuncInfo], f,
+                  env: Dict[str, Set[str]], node: ast.AST) -> Set[str]:
+        """Abstract type of an expression: project class quals and/or
+        ``ext:`` markers; empty set means unknown."""
+        if isinstance(node, ast.Constant):
+            return {EXT + "builtins." + type(node.value).__name__}
+        if isinstance(node, (ast.Dict, ast.DictComp)):
+            return {EXT + "builtins.dict"}
+        if isinstance(node, (ast.List, ast.ListComp)):
+            return {EXT + "builtins.list"}
+        if isinstance(node, (ast.Set, ast.SetComp)):
+            return {EXT + "builtins.set"}
+        if isinstance(node, (ast.Tuple, ast.GeneratorExp)):
+            return {EXT + "builtins.tuple"}
+        if isinstance(node, ast.JoinedStr):
+            return {EXT + "builtins.str"}
+        if isinstance(node, ast.Name):
+            if owner is not None and node.id == owner.self_name \
+                    and owner.cls is not None:
+                return {owner.cls.qual}
+            if node.id in env:
+                return env[node.id]
+            if owner is not None and (id(owner), node.id) in self.param_types:
+                return self.param_types[(id(owner), node.id)]
+            got = self.global_types.get((f.rel, node.id))
+            if got:
+                return got
+            # an imported module-level singleton (unique tail match)
+            target = self.aliases.get(f.rel, {}).get(node.id)
+            if target:
+                tail = target.rsplit(".", 1)[-1]
+                hits = [t for (rel, n), t in self.global_types.items()
+                        if n == tail]
+                if len(hits) == 1:
+                    return hits[0]
+            return set()
+        if isinstance(node, ast.Attribute):
+            out: Set[str] = set()
+            for t in self.expr_type(owner, f, env, node.value):
+                if t.startswith(EXT):
+                    continue
+                out |= self.attr_types.get((t, node.attr), set())
+            return out
+        if isinstance(node, ast.Call):
+            name = dotted(node.func)
+            if name:
+                head = name.split(".")[0]
+                classes = self.resolve_class(f.rel, name) if "." not in name \
+                    else self.resolve_class(f.rel, name.rsplit(".", 1)[-1])
+                if "." not in name and classes:
+                    return {c.qual for c in classes}
+                canon = canonical_call(node, self.aliases.get(f.rel, {}))
+                if "." in name and head in self.aliases.get(f.rel, {}):
+                    # module.Attr(...) through an import: external unless the
+                    # tail names a project class
+                    if classes and any(c.name == name.rsplit(".", 1)[-1]
+                                       for c in classes):
+                        return {c.qual for c in classes}
+                    return {EXT + canon}
+                if "." not in name:
+                    fns = self.resolve_bare(owner, f.rel, name)
+                    out = set()
+                    for fn in fns:
+                        out |= self.return_types.get(id(fn), set())
+                    if out:
+                        return out
+                    if not fns:
+                        if name in _BUILTIN_CTORS:
+                            return {EXT + "builtins." + name}
+                        # imported external constructor used bare
+                        # (``deque(...)``, ``Future()``)
+                        target = self.aliases.get(f.rel, {}).get(name)
+                        if target and (name[:1].isupper()
+                                       or target.startswith("collections.")):
+                            return {EXT + target}
+            # method call: type through resolved targets' returns
+            if isinstance(node.func, ast.Attribute):
+                out = set()
+                for m in self._typed_methods(owner, f, env, node.func):
+                    out |= self.return_types.get(id(m), set())
+                return out
+            return set()
+        return set()
+
+    def _typed_methods(self, owner, f, env,
+                       attr: ast.Attribute) -> List[FuncInfo]:
+        """Resolve ``<recv>.name`` to methods via the receiver's abstract
+        type; empty when the receiver is known-external."""
+        rtypes = self.expr_type(owner, f, env, attr.value)
+        targets: List[FuncInfo] = []
+        ext_only = bool(rtypes) and all(t.startswith(EXT) for t in rtypes)
+        for t in rtypes:
+            if t.startswith(EXT):
+                continue
+            ci = self._class_by_qual(t)
+            if ci is not None:
+                m = self.class_method(ci, attr.attr)
+                if m is not None:
+                    targets.append(m)
+        if targets:
+            return targets
+        if ext_only:
+            return []
+        # a class used as a namespace: Log.debug(...)
+        if isinstance(attr.value, ast.Name):
+            for ci in self.resolve_class(f.rel, attr.value.id):
+                m = self.class_method(ci, attr.attr)
+                if m is not None:
+                    targets.append(m)
+            if targets:
+                return targets
+        return self.methods_by_name.get(attr.attr, [])
+
+    def _extract_facts(self) -> None:
+        """One AST pass per scope, reused by every fixpoint round — the
+        type iteration must not pay a fresh tree walk per function per
+        round. Per function: local Name assignments (source order, for
+        ``_local_env``), ``self.<attr> =`` sites, return values, calls."""
+        self._mod_assigns: Dict[str, List[Tuple[str, ast.AST]]] = {}
+        for f in self.files:
+            pairs: List[Tuple[str, ast.AST]] = []
+            for node in own_walk(f.tree):
+                if isinstance(node, ast.Assign) \
+                        and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name):
+                    pairs.append((node.targets[0].id, node.value))
+                elif isinstance(node, ast.AnnAssign) \
+                        and isinstance(node.target, ast.Name) \
+                        and node.value is not None:
+                    pairs.append((node.target.id, node.value))
+            self._mod_assigns[f.rel] = pairs
+        self._fn_facts: Dict[int, tuple] = {}
+        for fn in self.funcs:
+            sname = fn.self_name
+            locals_: List[Tuple[List[str], ast.AST]] = []
+            attrs: List[Tuple[str, Optional[ast.AST]]] = []
+            rets: List[ast.AST] = []
+            calls: List[ast.Call] = []
+            for node in own_walk(fn.node):
+                if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    targets = node.targets \
+                        if isinstance(node, ast.Assign) else [node.target]
+                    names = [t.id for t in targets
+                             if isinstance(t, ast.Name)]
+                    if names and node.value is not None:
+                        locals_.append((names, node.value))
+                    if fn.cls is not None:
+                        for tgt in targets:
+                            if isinstance(tgt, ast.Attribute) \
+                                    and isinstance(tgt.value, ast.Name) \
+                                    and tgt.value.id == sname:
+                                attrs.append((tgt.attr, node.value))
+                elif isinstance(node, ast.Return) \
+                        and node.value is not None:
+                    rets.append(node.value)
+                elif isinstance(node, ast.Call):
+                    calls.append(node)
+            self._fn_facts[id(fn)] = (locals_, attrs, rets, calls)
+
+    def _local_env(self, fn: FuncInfo) -> Dict[str, Set[str]]:
+        env: Dict[str, Set[str]] = {}
+        for names, value in self._fn_facts[id(fn)][0]:
+            t = self.expr_type(fn, fn.file, env, value)
+            if not t:
+                continue
+            for name in names:
+                env.setdefault(name, set()).update(t)
+        return env
+
+    def _infer_types(self) -> None:
+        # module-level constructor assignments seed global singleton types
+        for _round in range(5):
+            before = (sum(len(v) for v in self.attr_types.values()),
+                      sum(len(v) for v in self.param_types.values()),
+                      sum(len(v) for v in self.return_types.values()),
+                      sum(len(v) for v in self.global_types.values()))
+            for f in self.files:
+                for name, value in self._mod_assigns[f.rel]:
+                    t = self.expr_type(None, f, {}, value)
+                    if t:
+                        self.global_types.setdefault(
+                            (f.rel, name), set()).update(t)
+            for fn in self.funcs:
+                env = self._local_env(fn)
+                _, attrs, rets, calls = self._fn_facts[id(fn)]
+                for attr, value in attrs:
+                    self.attr_owners.setdefault(
+                        attr, set()).add(fn.cls.qual)
+                    t = self.expr_type(fn, fn.file, env, value) \
+                        if value is not None else set()
+                    if t:
+                        self.attr_types.setdefault(
+                            (fn.cls.qual, attr), set()).update(t)
+                for value in rets:
+                    t = self.expr_type(fn, fn.file, env, value)
+                    if t:
+                        self.return_types.setdefault(
+                            id(fn), set()).update(t)
+                for node in calls:
+                    self._bind_params(fn, env, node)
+            after = (sum(len(v) for v in self.attr_types.values()),
+                     sum(len(v) for v in self.param_types.values()),
+                     sum(len(v) for v in self.return_types.values()),
+                     sum(len(v) for v in self.global_types.values()))
+            if after == before:
+                break
+
+    def _bind_params(self, owner: Optional[FuncInfo],
+                     env: Dict[str, Set[str]], node: ast.Call) -> None:
+        """Flow argument types into the parameters of resolved callees."""
+        f = owner.file if owner is not None else None
+        if f is None:
+            return
+        callees: List[Tuple[FuncInfo, int]] = []  # (fn, positional offset)
+        name = dotted(node.func)
+        if name and "." not in name:
+            for ci in self.resolve_class(f.rel, name):
+                init = self.class_method(ci, "__init__")
+                if init is not None:
+                    callees.append((init, 1))
+            if not callees:
+                for fn2 in self.resolve_bare(owner, f.rel, name):
+                    callees.append((fn2, 0))
+        elif isinstance(node.func, ast.Attribute):
+            for m in self._typed_methods(owner, f, env, node.func):
+                callees.append((m, 1 if m.is_method else 0))
+        for fn2, off in callees:
+            params = [a.arg for a in fn2.node.args.posonlyargs
+                      + fn2.node.args.args][off:]
+            for i, a in enumerate(node.args):
+                if isinstance(a, ast.Starred) or i >= len(params):
+                    break
+                t = self.expr_type(owner, f, env, a)
+                if t:
+                    self.param_types.setdefault(
+                        (id(fn2), params[i]), set()).update(t)
+            kwnames = {a.arg for a in fn2.node.args.args
+                       + fn2.node.args.kwonlyargs}
+            for kw in node.keywords:
+                if kw.arg in kwnames:
+                    t = self.expr_type(owner, f, env, kw.value)
+                    if t:
+                        self.param_types.setdefault(
+                            (id(fn2), kw.arg), set()).update(t)
+
+    # ------------------------------------------------------------ call graph
+    def _build_edges(self) -> None:
+        envs = {id(fn): self._local_env(fn) for fn in self.funcs}
+        for f in self.files:
+            self._scan_calls(None, f, f.tree, {})
+        for fn in self.funcs:
+            self._scan_calls(fn, fn.file, fn.node, envs[id(fn)])
+
+    def _scan_calls(self, owner: Optional[FuncInfo], f, body,
+                    env: Dict[str, Set[str]]) -> None:
+        aliases = self.aliases.get(f.rel, {})
+        for node in own_walk(body):
+            if not isinstance(node, ast.Call):
+                continue
+            cname = canonical_call(node, aliases)
+            wraps = (cname in JIT_HEADS or cname.endswith(".jit")
+                     or cname in PARTIAL_HEADS)
+            for a in call_name_args(node):
+                for target in self.resolve_bare(owner, f.rel, a.id):
+                    if wraps:
+                        self._value_entries.append(target)
+                    elif owner is not None:
+                        owner.edges.append(target)
+            if owner is None:
+                continue
+            fn = node.func
+            if isinstance(fn, ast.Name):
+                owner.edges.extend(self.resolve_bare(owner, f.rel, fn.id))
+            elif isinstance(fn, ast.Attribute):
+                owner.edges.extend(self._typed_methods(owner, f, env, fn))
+
+    # --------------------------------------------------------------- entries
+    def jit_entries(self) -> List[FuncInfo]:
+        """Functions that start a jit trace: jit-decorated plus handed by
+        value to ``jax.jit``/``partial``."""
+        out = [fn for fn in self.funcs
+               if any(is_jit_decorator(d) for d in fn.node.decorator_list)]
+        out.extend(self._value_entries)
+        return out
+
+    def _resolve_callable_arg(self, owner: Optional[FuncInfo], f,
+                              node: ast.AST) -> List[FuncInfo]:
+        """A thread-target expression to functions: bare names lexically,
+        ``self.m`` / ``obj.m`` through the receiver's class."""
+        if isinstance(node, ast.Name):
+            return self.resolve_bare(owner, f.rel, node.id)
+        if isinstance(node, ast.Attribute):
+            env = self._local_env(owner) if owner is not None else {}
+            return self._typed_methods(owner, f, env, node)
+        return []
+
+    def thread_entries(self) -> List[Tuple[FuncInfo, str]]:
+        """(function, root label) pairs for every discovered thread root:
+        ``threading.Thread(target=...)`` / ``Timer``, functions submitted
+        to ``concurrent.futures`` executors, and ``do_*`` methods of
+        ``BaseHTTPRequestHandler`` subclasses."""
+        out: List[Tuple[FuncInfo, str]] = []
+        seen: Set[int] = set()
+
+        def add(fns: Iterable[FuncInfo], label: str) -> None:
+            for fn in fns:
+                if id(fn) not in seen:
+                    seen.add(id(fn))
+                    out.append((fn, label))
+
+        for f in self.files:
+            aliases = self.aliases.get(f.rel, {})
+            scopes: List[Tuple[Optional[FuncInfo], ast.AST]] = [(None, f.tree)]
+            scopes += [(fn, fn.node) for fn in self.funcs if fn.file is f]
+            for owner, body in scopes:
+                for node in own_walk(body):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    cname = canonical_call(node, aliases)
+                    if cname in _THREAD_CTORS or cname.endswith(".Thread"):
+                        target = None
+                        for kw in node.keywords:
+                            if kw.arg in ("target", "function"):
+                                target = kw.value
+                        if target is None and cname.endswith("Timer") \
+                                and len(node.args) >= 2:
+                            target = node.args[1]
+                        elif target is None and len(node.args) >= 2:
+                            target = node.args[1]  # Thread(group, target)
+                        if target is not None:
+                            add(self._resolve_callable_arg(owner, f, target),
+                                "thread(%s:%d)" % (f.rel, node.lineno))
+                    elif isinstance(node.func, ast.Attribute) \
+                            and node.func.attr == "submit" and node.args:
+                        env = self._local_env(owner) if owner else {}
+                        rtypes = self.expr_type(owner, f, env,
+                                                node.func.value)
+                        if any(t.startswith(EXT) and "futures" in t
+                               for t in rtypes):
+                            add(self._resolve_callable_arg(
+                                owner, f, node.args[0]),
+                                "executor(%s:%d)" % (f.rel, node.lineno))
+        for ci in self.classes:
+            if any(b.rsplit(".", 1)[-1] in ("BaseHTTPRequestHandler",
+                                            "SimpleHTTPRequestHandler",
+                                            "CGIHTTPRequestHandler")
+                   for b in ci.bases):
+                add((m for name, m in sorted(ci.methods.items())
+                     if name.startswith("do_")),
+                    "http-handler(%s)" % ci.qual)
+        return out
+
+    # ---------------------------------------------------------- reachability
+    def closure(self, entries: Iterable[FuncInfo]) -> Set[int]:
+        """ids of every function reachable from ``entries`` through call
+        edges; nested defs of reachable functions are reachable (they
+        trace/run with their parent)."""
+        hot: Set[int] = set()
+        work: List[FuncInfo] = []
+        for e in entries:
+            if id(e) not in hot:
+                hot.add(id(e))
+                work.append(e)
+        while work:
+            cur = work.pop()
+            nxt: List[FuncInfo] = list(cur.edges)
+            for group in cur.children.values():
+                nxt.extend(group)
+            for fn in nxt:
+                if id(fn) not in hot:
+                    hot.add(id(fn))
+                    work.append(fn)
+        return hot
+
+
+def graph_for(project, files: Sequence, key: str) -> ProjectGraph:
+    """Build (or fetch the cached) engine over ``files``; the cache lives
+    on the Project so every rule of one lint run shares one build."""
+    cache = getattr(project, "_graphs", None)
+    if cache is None:
+        cache = project._graphs = {}
+    g = cache.get(key)
+    if g is None:
+        g = cache[key] = ProjectGraph(files)
+    return g

@@ -1,0 +1,24 @@
+"""graftlint rule set: this codebase's real hazard classes.
+
+Each rule encodes an invariant that regressed (or nearly regressed) in a
+past perf round — see ISSUE 4 / ISSUE 6 / PERF.md. Importing this package
+registers every rule via the :func:`~..core.register` decorator;
+``scripts/lint.py --list-rules`` prints the table.
+
+Layout (split from the PR 4 single-file ``rules.py`` when the
+interprocedural rules landed):
+
+- :mod:`.timing`    — ``naked-timer``
+- :mod:`.hostsync`  — ``host-sync`` (on the :mod:`..graph` engine)
+- :mod:`.dtypes`    — ``implicit-dtype``, ``dtype-promotion``
+- :mod:`.structure` — ``unnamed-pallas-call``, ``mutable-default``,
+  ``module-mutable-state``
+- :mod:`.threads`   — ``lock-discipline`` (thread roots x shared state)
+- :mod:`.tracer`    — ``tracer-leak`` (python control flow on traced values)
+"""
+from ..astutil import (  # noqa: F401  (re-exported for rule authors/tests)
+    canonical_call,
+    dotted,
+    import_aliases,
+)
+from . import dtypes, hostsync, structure, threads, timing, tracer  # noqa: F401

@@ -1,0 +1,308 @@
+"""implicit-dtype and dtype-promotion: explicit, stable dtypes in ops/.
+
+``implicit-dtype`` (PR 4) forces constructors to spell their dtype out.
+``dtype-promotion`` (ISSUE 6) goes further: it propagates the declared
+dtypes through local dataflow and flags the two promotions that actually
+cost on this hardware — f32 meeting f64 (silent 2x widening of a kernel
+intermediate) and i32 meeting i64 (indices leaving the fast lane). Python
+literals are weak-typed and adopt the array's dtype, so ``x * 0.5`` on an
+f32 array stays clean.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from ..astutil import (canonical_call, dotted, import_aliases_cached,
+                       kwarg_names, own_walk)
+from ..core import Finding, Rule, SourceFile, register
+
+#: constructor -> index of the positional dtype parameter
+_DTYPE_POS = {"zeros": 1, "ones": 1, "empty": 1, "full": 2, "arange": 3,
+              "asarray": 1}
+_JNP_HEADS = {"jax.numpy", "jnp"}
+
+
+@register
+class ImplicitDtypeRule(Rule):
+    """ops/ kernels must spell dtypes out: implicit f32/i32 promotion
+    changed bit patterns across jax versions and hid u8-vs-i32 traffic
+    regressions; golden/consistency tests pin the explicit choice."""
+
+    id = "implicit-dtype"
+    description = ("jnp.zeros/ones/empty/full/arange/asarray without an "
+                   "explicit dtype in lightgbm_tpu/ops/ kernels")
+
+    def check_file(self, f: SourceFile) -> Iterator[Finding]:
+        if not f.rel.startswith("lightgbm_tpu/ops/"):
+            return
+        aliases = import_aliases_cached(f)
+        for node in f.walk_nodes():
+            if not isinstance(node, ast.Call):
+                continue
+            cname = canonical_call(node, aliases)
+            head, _, tail = cname.rpartition(".")
+            if head not in _JNP_HEADS and aliases.get(head, head) != "jax.numpy":
+                continue
+            pos = _DTYPE_POS.get(tail)
+            if pos is None:
+                continue
+            if "dtype" in kwarg_names(node) or len(node.args) > pos:
+                continue
+            yield f.finding(node, self.id,
+                            "%s without an explicit dtype" % dotted(node.func))
+
+
+# ---------------------------------------------------------------------------
+# dtype-promotion
+# ---------------------------------------------------------------------------
+
+_FLOATS = {"float16": 16, "bfloat16": 16, "float32": 32, "float64": 64}
+_INTS = {"int8": 8, "int16": 16, "int32": 32, "int64": 64,
+         "uint8": 8, "uint16": 16, "uint32": 32, "uint64": 64}
+_KNOWN = set(_FLOATS) | set(_INTS) | {"bool_", "bool"}
+
+#: variadic jnp families where argument dtypes meet
+_MEET_CALLS = {"add", "subtract", "multiply", "divide", "where",
+               "concatenate", "stack", "hstack", "vstack", "dot", "matmul",
+               "maximum", "minimum", "mod", "remainder", "equal",
+               "not_equal", "less", "greater", "less_equal",
+               "greater_equal"}
+#: pure-passthrough jnp calls: result dtype == first array argument's
+_PASS_CALLS = {"sum", "mean", "reshape", "transpose", "squeeze",
+               "expand_dims", "cumsum", "sort", "flip", "roll", "take",
+               "abs", "negative", "clip", "pad", "ravel", "broadcast_to",
+               "max", "min"}
+_PASS_METHODS = {"sum", "mean", "reshape", "transpose", "squeeze", "ravel",
+                 "cumsum", "sort", "clip", "copy", "T", "max", "min"}
+#: index consumers: (callee tail, index argument position)
+_INDEX_CALLS = {"take": 1, "take_along_axis": 1, "bincount": 0,
+                "segment_sum": 1}
+
+
+def _family(d: str) -> Optional[str]:
+    if d in _FLOATS:
+        return "float"
+    if d in _INTS:
+        return "int"
+    return None
+
+
+def _width(d: str) -> int:
+    return _FLOATS.get(d) or _INTS.get(d) or 0
+
+
+@register
+class DtypePromotionRule(Rule):
+    """Propagate declared dtypes through ops/ kernels and flag f32/f64
+    meets, i32/i64 meets, and int64 values used as indices. This retires
+    the manual implicit-dtype audit from PERF.md: the declared dtype is
+    now checked at every point of use, not just at construction."""
+
+    id = "dtype-promotion"
+    description = ("f32/f64 or i32/i64 dtype meet (silent widening) or "
+                   "int64 indexing in lightgbm_tpu/ops/ kernels")
+
+    def check_file(self, f: SourceFile) -> Iterator[Finding]:
+        if not f.rel.startswith("lightgbm_tpu/ops/"):
+            return
+        aliases = import_aliases_cached(f)
+        # module-level declared constants participate
+        genv = self._scan_block(None, f, aliases, f.tree.body, {}, None)
+        for node in f.walk_nodes():
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                found: Dict[Tuple[int, int], Finding] = {}
+                env = dict(genv)
+                # two passes: loop-carried vars get their dtype on round 2
+                for _ in range(2):
+                    env = self._scan_block(node, f, aliases, node.body,
+                                           env, found)
+                yield from found.values()
+
+    # ------------------------------------------------------------- dtype eval
+    def _dtype_expr(self, e: ast.AST, aliases: Dict[str, str]
+                    ) -> Optional[str]:
+        """A dtype ANNOTATION expression -> canonical name ('float32')."""
+        if isinstance(e, ast.Constant) and isinstance(e.value, str):
+            return e.value if e.value in _KNOWN else None
+        name = dotted(e)
+        if name:
+            tail = name.rsplit(".", 1)[-1]
+            if tail in _KNOWN:
+                return tail
+        if isinstance(e, ast.Call):  # jnp.dtype("float32") etc.
+            if e.args:
+                return self._dtype_expr(e.args[0], aliases)
+        return None
+
+    def _is_jnp(self, cname: str, aliases: Dict[str, str]) -> bool:
+        head, _, _tail = cname.rpartition(".")
+        return head in _JNP_HEADS or aliases.get(head, head) == "jax.numpy" \
+            or head == "jax.numpy"
+
+    def _value_dtype(self, e: ast.AST, env: Dict[str, str],
+                     aliases: Dict[str, str],
+                     report) -> Optional[str]:
+        """Abstract dtype of a VALUE expression; None = unknown/weak."""
+        if isinstance(e, ast.Name):
+            return env.get(e.id)
+        if isinstance(e, ast.Subscript):
+            return self._value_dtype(e.value, env, aliases, report)
+        if isinstance(e, ast.Attribute):
+            if e.attr == "T":
+                return self._value_dtype(e.value, env, aliases, report)
+            return None
+        if isinstance(e, ast.UnaryOp):
+            return self._value_dtype(e.operand, env, aliases, report)
+        if isinstance(e, ast.BinOp):
+            lt = self._value_dtype(e.left, env, aliases, report)
+            rt = self._value_dtype(e.right, env, aliases, report)
+            return self._meet(lt, rt, e, report)
+        if isinstance(e, ast.IfExp):
+            lt = self._value_dtype(e.body, env, aliases, report)
+            rt = self._value_dtype(e.orelse, env, aliases, report)
+            return self._meet(lt, rt, e, report)
+        if isinstance(e, ast.Compare):
+            ds = [self._value_dtype(e.left, env, aliases, report)]
+            ds += [self._value_dtype(c, env, aliases, report)
+                   for c in e.comparators]
+            out = None
+            for d in ds:
+                out = self._meet(out, d, e, report)
+            return "bool_"
+        if isinstance(e, ast.Call):
+            return self._call_dtype(e, env, aliases, report)
+        return None
+
+    def _call_dtype(self, e: ast.Call, env: Dict[str, str],
+                    aliases: Dict[str, str], report) -> Optional[str]:
+        fc = e.func
+        # x.astype(D) / method passthrough
+        if isinstance(fc, ast.Attribute):
+            if fc.attr == "astype" and e.args:
+                return self._dtype_expr(e.args[0], aliases)
+            if fc.attr in _PASS_METHODS:
+                return self._value_dtype(fc.value, env, aliases, report)
+        cname = canonical_call(e, aliases)
+        if not cname or not self._is_jnp(cname, aliases):
+            return None
+        tail = cname.rsplit(".", 1)[-1]
+        # explicit dtype argument wins
+        for kw in e.keywords:
+            if kw.arg == "dtype":
+                return self._dtype_expr(kw.value, aliases)
+        pos = _DTYPE_POS.get(tail)
+        if pos is not None and len(e.args) > pos:
+            d = self._dtype_expr(e.args[pos], aliases)
+            if d:
+                return d
+        if tail in _KNOWN and e.args:  # jnp.float32(x) cast
+            return tail
+        # index consumers: flag int64 indices
+        ipos = _INDEX_CALLS.get(tail)
+        if ipos is not None and len(e.args) > ipos:
+            d = self._value_dtype(e.args[ipos], env, aliases, report)
+            if d == "int64" and report is not None:
+                report(e, "int64 indices into jnp.%s (indices should stay "
+                          "int32 on this hardware)" % tail)
+        if tail in _MEET_CALLS:
+            out = None
+            skip = 1 if tail == "where" else 0  # condition arg is bool
+            for i, a in enumerate(e.args):
+                if i < skip:
+                    continue
+                if isinstance(a, (ast.List, ast.Tuple)):
+                    for el in a.elts:
+                        out = self._meet(out, self._value_dtype(
+                            el, env, aliases, report), e, report)
+                else:
+                    out = self._meet(out, self._value_dtype(
+                        a, env, aliases, report), e, report)
+            if tail in ("equal", "not_equal", "less", "greater",
+                        "less_equal", "greater_equal"):
+                return "bool_"
+            return out
+        if tail in _PASS_CALLS and e.args:
+            return self._value_dtype(e.args[0], env, aliases, report)
+        return None
+
+    def _meet(self, a: Optional[str], b: Optional[str], node: ast.AST,
+              report) -> Optional[str]:
+        if a is None:
+            return b
+        if b is None or a == b:
+            return a
+        fa, fb = _family(a), _family(b)
+        if fa == fb and fa is not None and _width(a) != _width(b):
+            wide, narrow = (a, b) if _width(a) > _width(b) else (b, a)
+            if {a, b} == {"float32", "float64"} \
+                    or (fa == "int" and {_width(a), _width(b)} == {32, 64}):
+                if report is not None:
+                    report(node, "%s meets %s (silent promotion to %s; "
+                                 "align dtypes explicitly)"
+                           % (narrow, wide, wide))
+            return wide
+        if fa == "float":
+            return a
+        if fb == "float":
+            return b
+        return None
+
+    # ---------------------------------------------------------------- driver
+    def _scan_block(self, fn_node, f: SourceFile, aliases: Dict[str, str],
+                    body: List[ast.stmt], env: Dict[str, str],
+                    found: Optional[Dict[Tuple[int, int], Finding]]
+                    ) -> Dict[str, str]:
+        def report(node: ast.AST, msg: str) -> None:
+            if found is None:
+                return
+            key = (node.lineno, node.col_offset)
+            if key not in found:
+                found[key] = f.finding(node, self.id, msg)
+
+        rpt = report if found is not None else None
+
+        def stmts(block: List[ast.stmt]) -> None:
+            for s in block:
+                if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                    continue
+                if isinstance(s, ast.Assign):
+                    d = self._value_dtype(s.value, env, aliases, rpt)
+                    for t in s.targets:
+                        if isinstance(t, ast.Name):
+                            if d:
+                                env[t.id] = d
+                            else:
+                                env.pop(t.id, None)
+                elif isinstance(s, ast.AnnAssign) and s.value is not None \
+                        and isinstance(s.target, ast.Name):
+                    d = self._value_dtype(s.value, env, aliases, rpt)
+                    if d:
+                        env[s.target.id] = d
+                elif isinstance(s, ast.AugAssign) \
+                        and isinstance(s.target, ast.Name):
+                    lt = env.get(s.target.id)
+                    rt = self._value_dtype(s.value, env, aliases, rpt)
+                    d = self._meet(lt, rt, s, rpt)
+                    if d:
+                        env[s.target.id] = d
+                elif isinstance(s, (ast.Expr, ast.Return)):
+                    if s.value is not None:
+                        self._value_dtype(s.value, env, aliases, rpt)
+                for name in ("body", "orelse", "finalbody"):
+                    sub = getattr(s, name, None)
+                    if sub and not isinstance(s, (ast.FunctionDef,
+                                                  ast.AsyncFunctionDef,
+                                                  ast.ClassDef)):
+                        stmts(sub)
+                for h in getattr(s, "handlers", []) or []:
+                    stmts(h.body)
+                # visit tests/iters for index findings
+                for name in ("test", "iter"):
+                    sub = getattr(s, name, None)
+                    if sub is not None:
+                        self._value_dtype(sub, env, aliases, rpt)
+
+        stmts(body)
+        return env

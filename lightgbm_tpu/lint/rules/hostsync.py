@@ -1,0 +1,97 @@
+"""host-sync: no host-device syncs reachable from jit-traced hot phases.
+
+The reachability machinery (symbol table, jit entries, call edges) lived
+inside this rule in PR 4; it is now the shared :mod:`..graph` engine, and
+this module keeps only the sync-pattern detector and the hot-file scope.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, Optional
+
+from ..astutil import canonical_call, dotted, own_walk
+from ..core import Finding, Project, Rule, register
+from ..graph import graph_for
+
+#: the traced hot phases: learner/fused drive the per-split loops, ops/
+#: holds the kernels, serve/ the resident inference path
+HOT_FILES = ("lightgbm_tpu/learner.py", "lightgbm_tpu/fused.py")
+HOT_DIRS = ("lightgbm_tpu/ops/", "lightgbm_tpu/serve/")
+
+_SYNC_ATTR_CALLS = {"item", "tolist", "block_until_ready"}
+_SYNC_DOTTED = {"numpy.asarray", "numpy.array", "numpy.ascontiguousarray",
+                "jax.device_get"}
+_SYNC_BUILTINS = {"float", "int"}
+
+
+def hot_subset(project: Project):
+    return [f for f in project.files
+            if f.tree is not None
+            and (f.rel in HOT_FILES or f.rel.startswith(HOT_DIRS))]
+
+
+@register
+class HostSyncRule(Rule):
+    """No host-device syncs inside functions reachable from the traced hot
+    phases (the round-5 dispatch-soup class: one stray ``.item()`` or
+    ``np.asarray`` in the per-split loop serializes the pipeline).
+
+    Reachability comes from the :mod:`..graph` engine built over
+    learner.py, fused.py, ops/ and serve/: entries are jit-decorated
+    functions and functions wrapped by value in ``jax.jit``/``partial``
+    (the learner hands ``partial(build_tree*, ...)`` to jit); edges follow
+    bare-name calls (innermost lexical scope first, never methods),
+    ``x.attr(...)`` calls (typed receiver first, by-name fallback),
+    function-valued arguments (covers ``lax.while_loop``/``scan``/``vmap``
+    bodies), and nested defs of hot functions. ``float()``/``int()`` are
+    flagged only when the argument visibly involves a jax/jnp call —
+    static config scalars stay legal."""
+
+    id = "host-sync"
+    description = (".item()/float()/np.asarray/block_until_ready inside "
+                   "functions reachable from jit-traced hot phases")
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        hot_files = hot_subset(project)
+        if not hot_files:
+            return
+        g = graph_for(project, hot_files, "hot")
+        hot = g.closure(g.jit_entries())
+        for fn in g.funcs:
+            if id(fn) not in hot:
+                continue
+            aliases = g.aliases[fn.file.rel]
+            for node in own_walk(fn.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                hit = self._sync_kind(node, aliases)
+                if hit:
+                    yield fn.file.finding(
+                        node, self.id,
+                        "%s in '%s', reachable from a jit-traced hot "
+                        "phase (forces a host-device sync)"
+                        % (hit, fn.qual))
+
+    @staticmethod
+    def _arg_is_arrayish(node: ast.AST, aliases: Dict[str, str]) -> bool:
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call):
+                head = canonical_call(n, aliases).split(".")[0]
+                if head in ("jax", "jnp") or aliases.get(head) == "jax.numpy":
+                    return True
+        return False
+
+    @classmethod
+    def _sync_kind(cls, node: ast.Call,
+                   aliases: Dict[str, str]) -> Optional[str]:
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr in _SYNC_ATTR_CALLS \
+                and not node.args and not node.keywords:
+            return ".%s()" % fn.attr
+        cname = canonical_call(node, aliases)
+        if cname in _SYNC_DOTTED:
+            return "%s()" % dotted(node.func)
+        if cname in _SYNC_BUILTINS and node.args \
+                and cls._arg_is_arrayish(node.args[0], aliases):
+            return "%s() conversion" % cname
+        return None

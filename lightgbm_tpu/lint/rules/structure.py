@@ -1,0 +1,125 @@
+"""Structural hygiene rules: unnamed-pallas-call, mutable-default,
+module-mutable-state."""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, Tuple
+
+from ..astutil import dotted, is_mutable_literal, kwarg_names
+from ..core import Finding, Rule, SourceFile, register
+
+_MUTATOR_METHODS = {"append", "add", "update", "setdefault", "pop",
+                    "popitem", "clear", "extend", "insert", "remove",
+                    "discard"}
+
+
+@register
+class UnnamedPallasCallRule(Rule):
+    """``pallas_call`` without ``name=`` drops the kernel's identity from
+    profiler timelines and HLO dumps — PR 3's phase tracing (and every
+    trace-driven bisect script) keys on those names."""
+
+    id = "unnamed-pallas-call"
+    description = "pallas_call without a name= (breaks phase tracing)"
+
+    def check_file(self, f: SourceFile) -> Iterator[Finding]:
+        for node in f.walk_nodes():
+            if isinstance(node, ast.Call) \
+                    and dotted(node.func).rsplit(".", 1)[-1] == "pallas_call" \
+                    and "name" not in kwarg_names(node):
+                yield f.finding(node, self.id,
+                                "pallas_call without name= (kernel is "
+                                "anonymous in traces and HLO dumps)")
+
+
+@register
+class MutableDefaultRule(Rule):
+    """Mutable default arguments are shared across calls — with cached
+    jitted callables (``_BLOCK_CACHE``) a leaked default outlives the
+    Booster that wrote it."""
+
+    id = "mutable-default"
+    description = "mutable default argument (list/dict/set literal)"
+
+    def check_file(self, f: SourceFile) -> Iterator[Finding]:
+        for node in f.walk_nodes():
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                args = node.args
+                for d in list(args.defaults) + [
+                        d for d in args.kw_defaults if d is not None]:
+                    if is_mutable_literal(d):
+                        yield f.finding(
+                            d, self.id,
+                            "mutable default argument in '%s'"
+                            % getattr(node, "name", "<lambda>"))
+
+
+@register
+class ModuleMutableStateRule(Rule):
+    """Module-level mutable state written from function scope is a hidden
+    process-global — telemetry belongs in the ``obs`` registry (locked,
+    snapshot-able, reset-able), not in ad-hoc module dicts. Deliberate
+    caches carry an inline disable naming their invariant."""
+
+    id = "module-mutable-state"
+    description = ("module-level mutable literal written from function "
+                   "scope outside the obs registry")
+
+    def check_file(self, f: SourceFile) -> Iterator[Finding]:
+        if f.rel == "lightgbm_tpu/obs.py":
+            return
+        decls: Dict[str, ast.stmt] = {}
+        for node in f.tree.body:
+            target = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                target = node.targets[0].id
+                value = node.value
+            elif isinstance(node, ast.AnnAssign) \
+                    and isinstance(node.target, ast.Name) \
+                    and node.value is not None:
+                target = node.target.id
+                value = node.value
+            if target and is_mutable_literal(value):
+                decls[target] = node
+        if not decls:
+            return
+        writes: Dict[str, Tuple[int, str]] = {}
+
+        def visit_fn(fn_node):
+            for node in ast.walk(fn_node):
+                name, how = None, ""
+                if isinstance(node, (ast.Assign, ast.AugAssign)):
+                    targets = node.targets if isinstance(node, ast.Assign) \
+                        else [node.target]
+                    for t in targets:
+                        if isinstance(t, ast.Subscript) \
+                                and isinstance(t.value, ast.Name) \
+                                and t.value.id in decls:
+                            name, how = t.value.id, "subscript write"
+                elif isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute) \
+                        and isinstance(node.func.value, ast.Name) \
+                        and node.func.value.id in decls \
+                        and node.func.attr in _MUTATOR_METHODS:
+                    name, how = node.func.value.id, \
+                        ".%s()" % node.func.attr
+                elif isinstance(node, ast.Global):
+                    for n in node.names:
+                        if n in decls:
+                            name, how = n, "global rebind"
+                if name and name not in writes:
+                    writes[name] = (node.lineno, how)
+
+        for node in f.walk_nodes():
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                visit_fn(node)
+        for name, decl in decls.items():
+            if name in writes:
+                line, how = writes[name]
+                yield f.finding(
+                    decl, self.id,
+                    "module-level mutable '%s' written from function scope "
+                    "(%s at line %d); use the obs registry or justify with "
+                    "an inline disable" % (name, how, line))

@@ -1,0 +1,383 @@
+"""lock-discipline: shared mutable state must have a consistent lock.
+
+The hazard class this encodes is PR 5's: serving threads (the batcher
+worker, one stdlib-HTTP handler thread per connection) share Booster and
+session state with the training thread. A field written after ``__init__``
+and touched from two execution roots needs every access under ONE lock —
+or an explicit ``# graftlint: guarded-by=<lock>`` stating why the naked
+access is safe (atomic int read, monotonic flag, ...).
+
+Mechanics, on the :mod:`..graph` engine over ``lightgbm_tpu/``:
+
+- **roots**: one per discovered thread entry (``Thread(target=...)``,
+  executor ``submit``, HTTP ``do_*`` handler) plus an implicit ``main``
+  root covering everything not exclusively thread-internal;
+- **shared state**: instance attributes assigned somewhere via
+  ``self.<attr> =`` (the engine's attr-owner table), written outside
+  init-only methods, and accessed from >= 2 roots. Receivers resolve
+  through the engine's types, so ``g._pack_cache`` on a ``GBDT``-typed
+  local counts against the same field as ``self._pack_cache``;
+- **checked accesses**: every write/mutation anywhere, plus reads in
+  thread-reachable functions (a pure read on the main thread of a field
+  only threads write is torn-value-safe for the patterns here and stays
+  legal). Freshly constructed locals (``C(...)``, ``cls(...)``,
+  ``__new__``) are exempt: writes during construction precede sharing;
+- **guards**: lexical ``with <x>.<lockattr>:`` blocks where ``lockattr``
+  is typed ``threading.Lock/RLock/Condition``; lock identity is the final
+  attribute name, so ``with g._cache_lock`` in serve/ matches the
+  booster's ``with self._cache_lock``. All checked accesses of one field
+  must share at least one lock name.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, FrozenSet, Iterator, List, Optional, Set, Tuple
+
+from ..astutil import own_walk
+from ..core import Finding, Project, Rule, register
+from ..graph import EXT, FuncInfo, ProjectGraph, graph_for
+
+_LOCK_TYPES = {EXT + "threading.Lock", EXT + "threading.RLock",
+               EXT + "threading.Condition", EXT + "threading.Semaphore",
+               EXT + "threading.BoundedSemaphore"}
+
+#: container mutations that count as writes. Deliberately excludes
+#: queue put/get (SimpleQueue/Queue are internally locked) and Future
+#: set_result/set_exception (Future owns its condition).
+_MUTATORS = {"append", "extend", "insert", "add", "discard", "remove",
+             "clear", "update", "setdefault", "pop", "popitem"}
+
+_GUARDED_RE = re.compile(r"#\s*graftlint:\s*guarded-by=([A-Za-z0-9_.\-]+)")
+
+_READ, _WRITE, _MUTATE = "read", "write", "mutate"
+
+
+class _Access:
+    __slots__ = ("fn", "node", "kind")
+
+    def __init__(self, fn: FuncInfo, node: ast.AST, kind: str) -> None:
+        self.fn = fn
+        self.node = node
+        self.kind = kind
+
+
+def _fresh_ctor_name(name: str) -> bool:
+    return name == "cls" or name.endswith("_cls")
+
+
+@register
+class LockDisciplineRule(Rule):
+    """Shared mutable state (post-init instance attrs touched from >= 2
+    execution roots) must have every access under one consistent lock's
+    ``with``-block, or carry ``# graftlint: guarded-by=<lock>``."""
+
+    id = "lock-discipline"
+    description = ("shared attr reachable from >=2 thread roots accessed "
+                   "outside its lock's with-block")
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        files = [f for f in project.files
+                 if f.tree is not None
+                 and f.rel.startswith("lightgbm_tpu/")]
+        if not files:
+            return
+        g = graph_for(project, files, "pkg")
+        thread_roots = g.thread_entries()
+        if not thread_roots:
+            return
+
+        closures: Dict[str, Set[int]] = {}
+        in_thread: Set[int] = set()
+        target_ids = {id(fn) for fn, _ in thread_roots}
+        for fn, label in thread_roots:
+            cl = g.closure([fn])
+            closures.setdefault(label, set()).update(cl)
+            in_thread |= cl
+        main_closure = g.closure(
+            fn for fn in g.funcs if id(fn) not in in_thread)
+
+        lock_names = self._lock_names(g)
+        init_only = self._init_only(g, target_ids)
+        accesses, blessed = self._collect(g, lock_names, init_only)
+
+        for (owner, attr), accs in sorted(accesses.items()):
+            if (owner, attr) in blessed:
+                continue
+            roots: Set[str] = set()
+            for a in accs:
+                fid = id(a.fn)
+                roots.update(lbl for lbl, cl in closures.items()
+                             if fid in cl)
+                if fid in main_closure:
+                    roots.add("main")
+            if len(roots) < 2:
+                continue
+            if not any(a.kind in (_WRITE, _MUTATE) for a in accs):
+                continue  # immutable after init: reads need no lock
+            checked = [a for a in accs
+                       if a.kind in (_WRITE, _MUTATE)
+                       or id(a.fn) in in_thread]
+            if not checked:
+                continue
+            helds = [self._held(g, a, lock_names) for a in checked]
+            root_list = ", ".join(sorted(roots))
+            seen: Set[Tuple[str, int]] = set()
+            unguarded = [a for a, h in zip(checked, helds) if not h]
+            if unguarded:
+                for a in unguarded:
+                    key = (a.fn.file.rel, a.node.lineno)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    yield a.fn.file.finding(
+                        a.node, self.id,
+                        "%s of shared '%s.%s' (roots: %s) outside a lock; "
+                        "guard with its lock's with-block or annotate "
+                        "'# graftlint: guarded-by=<lock>'"
+                        % (a.kind, owner.rsplit(".", 1)[-1], attr,
+                           root_list))
+            elif not frozenset.intersection(*helds):
+                locks = sorted({n for h in helds for n in h})
+                a = checked[0]
+                yield a.fn.file.finding(
+                    a.node, self.id,
+                    "shared '%s.%s' (roots: %s) guarded by no single "
+                    "common lock (saw: %s)"
+                    % (owner.rsplit(".", 1)[-1], attr, root_list,
+                       ", ".join(locks)))
+
+    # ------------------------------------------------------------ lock names
+    @staticmethod
+    def _lock_names(g: ProjectGraph) -> Set[str]:
+        names = {attr for (_cls, attr), ts in g.attr_types.items()
+                 if ts & _LOCK_TYPES}
+        names |= {name for (_rel, name), ts in g.global_types.items()
+                  if ts & _LOCK_TYPES}
+        return names
+
+    # ---------------------------------------------------- init-only methods
+    @staticmethod
+    def _init_only(g: ProjectGraph, target_ids: Set[int]) -> Set[int]:
+        """ids of methods whose every caller is (transitively) an
+        ``__init__``: writes there happen before the object is shared."""
+        callers: Dict[int, List[FuncInfo]] = {}
+        for fn in g.funcs:
+            for tgt in fn.edges:
+                callers.setdefault(id(tgt), []).append(fn)
+        init: Set[int] = {id(fn) for fn in g.funcs
+                          if fn.is_method and fn.name == "__init__"}
+        changed = True
+        while changed:
+            changed = False
+            for fn in g.funcs:
+                fid = id(fn)
+                if fid in init or not fn.is_method or fid in target_ids:
+                    continue
+                cs = callers.get(fid)
+                if cs and all(id(c) in init for c in cs):
+                    init.add(fid)
+                    changed = True
+        return init
+
+    # ------------------------------------------------------------ collection
+    def _collect(self, g: ProjectGraph, lock_names: Set[str],
+                 init_only: Set[int]):
+        accesses: Dict[Tuple[str, str], List[_Access]] = {}
+        blessed: Set[Tuple[str, str]] = set()
+
+        def owner_of(cls_qual: str, attr: str,
+                     depth: int = 0) -> Optional[str]:
+            """Canonicalize subclass receivers onto the base that assigns
+            ``self.<attr>`` (RF accesses land on the GBDT field)."""
+            if cls_qual in g.attr_owners.get(attr, ()):
+                return cls_qual
+            if depth >= 4:
+                return None
+            ci = g._class_by_qual(cls_qual)
+            if ci is None:
+                return None
+            for b in ci.bases:
+                for bc in g.classes_by_name.get(b.rsplit(".", 1)[-1], []):
+                    got = owner_of(bc.qual, attr, depth + 1)
+                    if got:
+                        return got
+            return None
+
+        for fn in g.funcs:
+            f = fn.file
+            env = g._local_env(fn)
+            in_init = id(fn) in init_only
+            fresh: Set[str] = set()
+            alias: Dict[str, Set[Tuple[str, str]]] = {}
+
+            def recv_keys(expr: ast.AST, attr: str) -> Set[Tuple[str, str]]:
+                if isinstance(expr, ast.Name) and expr.id in fresh:
+                    return set()
+                out: Set[Tuple[str, str]] = set()
+                for t in g.expr_type(fn, f, env, expr):
+                    if t.startswith(EXT):
+                        continue
+                    o = owner_of(t, attr)
+                    if o:
+                        out.add((o, attr))
+                return out
+
+            def record(keys: Set[Tuple[str, str]], node: ast.AST,
+                       kind: str, is_self: bool) -> None:
+                for key in keys:
+                    if key[1] in lock_names:
+                        continue
+                    if in_init and is_self:
+                        if kind in (_WRITE, _MUTATE) \
+                                and _GUARDED_RE.search(
+                                    f.line_text(node.lineno)):
+                            blessed.add(key)
+                        continue
+                    accesses.setdefault(key, []).append(
+                        _Access(fn, node, kind))
+
+            def attr_keys(node: ast.Attribute) -> Set[Tuple[str, str]]:
+                return recv_keys(node.value, node.attr)
+
+            def is_self(expr: ast.AST) -> bool:
+                return isinstance(expr, ast.Name) \
+                    and expr.id == fn.self_name
+
+            # pre-pass: fresh locals and one-level aliases (order-free)
+            for node in own_walk(fn.node):
+                if not isinstance(node, ast.Assign):
+                    continue
+                names = [t.id for t in node.targets
+                         if isinstance(t, ast.Name)]
+                if not names:
+                    continue
+                v = node.value
+                if isinstance(v, ast.Call):
+                    vname = v.func
+                    # constructor / cls(...) / __new__ => fresh object
+                    if isinstance(vname, ast.Name) \
+                            and (g.resolve_class(f.rel, vname.id)
+                                 or _fresh_ctor_name(vname.id)):
+                        fresh.update(names)
+                    elif isinstance(vname, ast.Attribute) \
+                            and vname.attr == "__new__":
+                        fresh.update(names)
+                    elif isinstance(vname, ast.Name) \
+                            and vname.id == "getattr" \
+                            and len(v.args) >= 2 \
+                            and isinstance(v.args[1], ast.Constant) \
+                            and isinstance(v.args[1].value, str):
+                        ks = recv_keys(v.args[0], v.args[1].value)
+                        for n in names:
+                            alias.setdefault(n, set()).update(ks)
+                elif isinstance(v, ast.Attribute):
+                    ks = attr_keys(v)
+                    for n in names:
+                        alias.setdefault(n, set()).update(ks)
+                # chained `cache = self._pack_cache = {}`: alias the Name
+                # targets to the Attribute targets
+                atkeys: Set[Tuple[str, str]] = set()
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute):
+                        atkeys |= recv_keys(t.value, t.attr)
+                for n in names:
+                    alias.setdefault(n, set()).update(atkeys)
+
+            # main pass: reads, writes, mutations
+            for node in own_walk(fn.node):
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if isinstance(t, ast.Attribute):
+                            record(attr_keys(t), node, _WRITE,
+                                   is_self(t.value))
+                        elif isinstance(t, ast.Subscript):
+                            if isinstance(t.value, ast.Attribute):
+                                record(attr_keys(t.value), node, _MUTATE,
+                                       is_self(t.value.value))
+                            elif isinstance(t.value, ast.Name):
+                                record(alias.get(t.value.id, set()),
+                                       node, _MUTATE, False)
+                elif isinstance(node, ast.AugAssign):
+                    t = node.target
+                    if isinstance(t, ast.Attribute):
+                        record(attr_keys(t), node, _WRITE, is_self(t.value))
+                elif isinstance(node, ast.Delete):
+                    for t in node.targets:
+                        if isinstance(t, ast.Subscript) \
+                                and isinstance(t.value, ast.Attribute):
+                            record(attr_keys(t.value), node, _MUTATE,
+                                   is_self(t.value.value))
+                elif isinstance(node, ast.Call):
+                    fc = node.func
+                    if isinstance(fc, ast.Attribute) \
+                            and fc.attr in _MUTATORS:
+                        base = fc.value
+                        if isinstance(base, ast.Attribute):
+                            record(attr_keys(base), node, _MUTATE,
+                                   is_self(base.value))
+                        elif isinstance(base, ast.Name):
+                            record(alias.get(base.id, set()), node,
+                                   _MUTATE, False)
+                    elif isinstance(fc, ast.Name) and fc.id == "getattr" \
+                            and len(node.args) >= 2 \
+                            and isinstance(node.args[1], ast.Constant) \
+                            and isinstance(node.args[1].value, str):
+                        record(recv_keys(node.args[0],
+                                         node.args[1].value),
+                               node, _READ, is_self(node.args[0]))
+                elif isinstance(node, ast.Attribute) \
+                        and isinstance(node.ctx, ast.Load):
+                    record(attr_keys(node), node, _READ, is_self(node.value))
+        return accesses, blessed
+
+    # ----------------------------------------------------------- guard state
+    def _held(self, g: ProjectGraph, a: _Access,
+              lock_names: Set[str]) -> FrozenSet[str]:
+        fn = a.fn
+        maps = fn.file.__dict__.setdefault("_held_maps", {})
+        cache = maps.get(id(fn))
+        if cache is None:
+            cache = maps[id(fn)] = self._held_map(fn.node, lock_names)
+        held = cache.get(id(a.node), frozenset())
+        m = _GUARDED_RE.search(a.fn.file.line_text(a.node.lineno))
+        if m:
+            held = held | {m.group(1).rsplit(".", 1)[-1]}
+        return held
+
+    @staticmethod
+    def _held_map(fn_node: ast.AST,
+                  lock_names: Set[str]) -> Dict[int, FrozenSet[str]]:
+        out: Dict[int, FrozenSet[str]] = {}
+
+        def lock_tail(expr: ast.AST) -> Optional[str]:
+            while isinstance(expr, ast.Call):
+                expr = expr.func  # with self._lock.acquire_timeout(...):
+            if isinstance(expr, ast.Attribute):
+                return expr.attr if expr.attr in lock_names else None
+            if isinstance(expr, ast.Name):
+                return expr.id if expr.id in lock_names else None
+            return None
+
+        def visit(node: ast.AST, held: FrozenSet[str]) -> None:
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                names = set()
+                for item in node.items:
+                    tail = lock_tail(item.context_expr)
+                    if tail:
+                        names.add(tail)
+                    visit(item.context_expr, held)
+                inner = held | frozenset(names)
+                for stmt in node.body:
+                    visit(stmt, inner)
+                return
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef, ast.Lambda)):
+                return
+            out[id(node)] = held
+            for child in ast.iter_child_nodes(node):
+                visit(child, held)
+
+        for child in ast.iter_child_nodes(fn_node):
+            visit(child, frozenset())
+        return out

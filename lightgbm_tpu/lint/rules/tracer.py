@@ -1,0 +1,197 @@
+"""tracer-leak: python control flow on jit-traced values.
+
+Inside a jit-reachable function, ``if``/``while``/``assert`` on a value
+derived from a traced array either crashes at trace time
+(ConcretizationTypeError) or — worse — silently bakes one branch into the
+compiled program and retraces on every boundary flip. Shape/dtype/ndim
+tests are static and stay legal; concrete host conversions are the
+``host-sync`` rule's domain and are not re-reported here.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Set
+
+from ..astutil import canonical_call, own_walk
+from ..core import Finding, Project, Rule, register
+from ..graph import FuncInfo, graph_for
+from .hostsync import hot_subset
+
+#: where findings are reported (serve/ participates in reachability but
+#: branches on host numpy there, not tracers)
+_REPORT_FILES = ("lightgbm_tpu/learner.py", "lightgbm_tpu/fused.py")
+_REPORT_DIRS = ("lightgbm_tpu/ops/",)
+
+#: static attributes of a traced array — branching on them is legal
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding", "at",
+                 "weak_type", "aval"}
+
+#: calls whose result is concrete on the host regardless of the argument
+_CONCRETE_CALLS = {"len", "isinstance", "issubclass", "int", "float",
+                   "bool", "str", "repr", "getattr", "hasattr", "callable",
+                   "type", "id"}
+_CONCRETE_METHODS = {"item", "tolist", "keys", "values", "items", "get"}
+
+
+#: namespaces whose call results are traced arrays. Deliberately narrow:
+#: ``jax.default_backend()``/``jax.devices()`` are host calls, and pallas
+#: grid/BlockSpec plumbing consumes static shapes, not arrays.
+_TRACED_NS = ("jax.numpy.", "jax.lax.", "jax.nn.", "jax.scipy.",
+              "jax.random.", "jax.ops.")
+
+#: keyword args of jnp calls that carry static config, not array data
+_STATIC_KWARGS = {"shape", "dtype", "axis", "num", "size", "length",
+                  "total_repeat_length", "num_segments", "precision",
+                  "preferred_element_type", "indices_are_sorted",
+                  "unique_indices", "mode", "axis_name"}
+
+
+def _jaxish(cname: str) -> bool:
+    return cname.startswith(_TRACED_NS)
+
+
+def _ordered_stmts(body: List[ast.stmt]) -> Iterator[ast.stmt]:
+    for s in body:
+        yield s
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef)):
+            continue
+        for name in ("body", "orelse", "finalbody"):
+            sub = getattr(s, name, None)
+            if sub:
+                yield from _ordered_stmts(sub)
+        for h in getattr(s, "handlers", []) or []:
+            yield from _ordered_stmts(h.body)
+
+
+@register
+class TracerLeakRule(Rule):
+    """Python ``if``/``while``/``assert`` (or short-circuit ``and``/``or``)
+    on a value derived from traced arrays, inside functions reachable from
+    a jit entry."""
+
+    id = "tracer-leak"
+    description = ("python if/while/assert on a jit-traced value in "
+                   "learner.py/fused.py/ops/ hot functions")
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        hot_files = hot_subset(project)
+        if not hot_files:
+            return
+        g = graph_for(project, hot_files, "hot")
+        hot = g.closure(g.jit_entries())
+        for fn in g.funcs:
+            if id(fn) not in hot:
+                continue
+            rel = fn.file.rel
+            if rel not in _REPORT_FILES \
+                    and not rel.startswith(_REPORT_DIRS):
+                continue
+            yield from self._check_fn(g, fn)
+
+    def _check_fn(self, g, fn: FuncInfo) -> Iterator[Finding]:
+        aliases: Dict[str, str] = g.aliases[fn.file.rel]
+        params = {a.arg for a in fn.node.args.posonlyargs
+                  + fn.node.args.args + fn.node.args.kwonlyargs}
+        params.discard(fn.self_name)
+
+        # params count as traced only with array evidence: the param is fed
+        # DIRECTLY (not inside a shape tuple or static kwarg) to a jnp/lax
+        # call somewhere in this function
+        evidence: Set[str] = set()
+        for node in own_walk(fn.node):
+            if isinstance(node, ast.Call) \
+                    and _jaxish(canonical_call(node, aliases)):
+                direct = list(node.args) \
+                    + [k.value for k in node.keywords
+                       if k.arg not in _STATIC_KWARGS]
+                for a in direct:
+                    if isinstance(a, ast.Name) and a.id in params:
+                        evidence.add(a.id)
+        taint: Set[str] = set()
+
+        def is_tainted(e: ast.AST) -> bool:
+            if isinstance(e, ast.Name):
+                return e.id in taint or e.id in evidence
+            if isinstance(e, ast.Attribute):
+                if e.attr in _STATIC_ATTRS:
+                    return False
+                # attrs of an array-evidence param are config fields of a
+                # static struct (hp.max_delta_step), not traced values
+                if isinstance(e.value, ast.Name) \
+                        and e.value.id in evidence \
+                        and e.value.id not in taint:
+                    return False
+                return is_tainted(e.value)
+            if isinstance(e, ast.Subscript):
+                return is_tainted(e.value)
+            if isinstance(e, ast.Starred):
+                return is_tainted(e.value)
+            if isinstance(e, ast.Call):
+                cname = canonical_call(e, aliases)
+                if cname in _CONCRETE_CALLS:
+                    return False
+                if isinstance(e.func, ast.Attribute):
+                    if e.func.attr in _CONCRETE_METHODS \
+                            or e.func.attr in _STATIC_ATTRS:
+                        return False
+                    if _jaxish(cname):
+                        return True
+                    return is_tainted(e.func.value)
+                return _jaxish(cname)
+            if isinstance(e, ast.Compare):
+                if all(isinstance(op, (ast.Is, ast.IsNot)) for op in e.ops):
+                    return False
+                return is_tainted(e.left) \
+                    or any(is_tainted(c) for c in e.comparators)
+            if isinstance(e, ast.BinOp):
+                return is_tainted(e.left) or is_tainted(e.right)
+            if isinstance(e, ast.UnaryOp):
+                return is_tainted(e.operand)
+            if isinstance(e, ast.BoolOp):
+                return any(is_tainted(v) for v in e.values)
+            if isinstance(e, ast.IfExp):
+                return is_tainted(e.body) or is_tainted(e.orelse)
+            if isinstance(e, (ast.Tuple, ast.List)):
+                return any(is_tainted(v) for v in e.elts)
+            return False
+
+        # propagate through local assignments; two passes cover
+        # loop-carried values
+        stmts = list(_ordered_stmts(fn.node.body))
+        for _ in range(2):
+            for s in stmts:
+                if isinstance(s, ast.Assign):
+                    hit = is_tainted(s.value)
+                    for t in s.targets:
+                        names = [t] if isinstance(t, ast.Name) else [
+                            e for e in getattr(t, "elts", [])
+                            if isinstance(e, ast.Name)]
+                        for n in names:
+                            if hit:
+                                taint.add(n.id)
+                            else:
+                                taint.discard(n.id)
+                elif isinstance(s, ast.AugAssign) \
+                        and isinstance(s.target, ast.Name):
+                    if is_tainted(s.value):
+                        taint.add(s.target.id)
+
+        seen: Set[int] = set()
+        for s in stmts:
+            kind, test = None, None
+            if isinstance(s, ast.If):
+                kind, test = "if", s.test
+            elif isinstance(s, ast.While):
+                kind, test = "while", s.test
+            elif isinstance(s, ast.Assert):
+                kind, test = "assert", s.test
+            if test is None or id(test) in seen:
+                continue
+            seen.add(id(test))
+            if is_tainted(test):
+                yield fn.file.finding(
+                    s, self.id,
+                    "python %s on a traced value in jit-reachable '%s' "
+                    "(concretizes the tracer; use lax.cond/select or "
+                    "hoist the decision to the host)" % (kind, fn.qual))

@@ -58,6 +58,11 @@ class MicroBatcher:
         self._max_wait = float(max_wait_ms) / 1000.0
         self._raw = bool(raw_score)
         self._q: "queue.SimpleQueue" = queue.SimpleQueue()
+        # one lock, two jobs: (a) makes submit's closed-check atomic with
+        # the enqueue so no request can slip in behind close()'s _STOP and
+        # hang its Future forever; (b) guards the latency deque, which the
+        # worker appends to while callers read latency_stats()
+        self._lock = threading.Lock()
         self._lat: deque = deque(maxlen=int(latency_window))
         self._closed = False
         self._thread = threading.Thread(
@@ -68,16 +73,20 @@ class MicroBatcher:
     def submit(self, X) -> Future:
         """Queue one request; returns a Future resolving to its predictions
         (same shapes as ``PredictSession.predict``). A 1-D row is treated
-        as a single-row batch."""
-        if self._closed:
-            raise RuntimeError("MicroBatcher is closed")
+        as a single-row batch. Raises ``RuntimeError`` once the batcher is
+        closed — atomically with close(), so a submit either lands before
+        the worker's stop marker (and gets an answer or a deterministic
+        'closed' failure from the drain) or raises here; it never hangs."""
         X = np.asarray(X, np.float64)
         if X.ndim == 1:
             X = X[None, :]
         req = _Request(X)
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("MicroBatcher is closed")
+            self._q.put(req)
         telemetry.count("serve/requests")
         telemetry.count("serve/rows", req.rows)
-        self._q.put(req)
         telemetry.gauge("serve/queue_depth", self._q.qsize())
         return req.future
 
@@ -140,14 +149,16 @@ class MicroBatcher:
             r.future.set_result(np.array(out[off:off + r.rows]))
             off += r.rows
             dt = now - r.t0
-            self._lat.append(dt)
+            with self._lock:
+                self._lat.append(dt)
             telemetry.add_time("wall/serve/request", dt)
         self._update_latency_gauges()
 
     def _update_latency_gauges(self) -> None:
-        if not self._lat:
-            return
-        ms = np.asarray(self._lat, np.float64) * 1000.0
+        with self._lock:
+            if not self._lat:
+                return
+            ms = np.asarray(self._lat, np.float64) * 1000.0
         telemetry.gauge("serve/latency_p50_ms",
                         round(float(np.percentile(ms, 50)), 4))
         telemetry.gauge("serve/latency_p99_ms",
@@ -155,7 +166,8 @@ class MicroBatcher:
 
     def latency_stats(self) -> dict:
         """p50/p99/count over the sliding latency window (seconds)."""
-        lat = sorted(self._lat)
+        with self._lock:
+            lat = sorted(self._lat)
         if not lat:
             return {"count": 0, "p50_s": 0.0, "p99_s": 0.0}
         arr = np.asarray(lat, np.float64)
@@ -177,11 +189,14 @@ class MicroBatcher:
 
     def close(self, timeout: Optional[float] = None) -> None:
         """Stop accepting requests, finish the in-flight batch, fail any
-        still-queued futures, join the worker. Idempotent."""
-        if self._closed:
-            return
-        self._closed = True
-        self._q.put(_STOP)
+        still-queued futures, join the worker. Idempotent. The flag flip
+        and the stop marker go in under the submit lock, so every request
+        that beat the flip sits ahead of _STOP and gets drained."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            self._q.put(_STOP)
         self._thread.join(timeout)
 
     def __enter__(self) -> "MicroBatcher":

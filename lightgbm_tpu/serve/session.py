@@ -105,7 +105,12 @@ class PredictSession:
         """Refresh the device-resident pack iff the model version (or the
         resolved iteration range) moved; returns (pack, has_cat)."""
         g = self._gbdt
-        with self._lock:
+        # lock order is session -> booster (nothing takes them the other
+        # way round). Holding the booster's model lock across the
+        # version read, range resolution and pack build pins one
+        # (models, version) pair — a concurrent training commit lands
+        # wholly before or wholly after this snapshot, never inside it.
+        with self._lock, g._cache_lock:
             ver = g.model_version
             rng = self._resolve_range()
             if self._pack is None or ver != self._version \

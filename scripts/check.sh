@@ -1,0 +1,11 @@
+#!/bin/sh
+# Pre-commit gate: full-repo graftlint + the linter's own test suite.
+# Both are jax-light and finish in well under a minute on CPU.
+set -e
+cd "$(dirname "$0")/.."
+
+echo "== graftlint (full repo) =="
+python scripts/lint.py
+
+echo "== lint tests =="
+JAX_PLATFORMS=cpu python -m pytest tests/test_lint.py -q

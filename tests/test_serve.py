@@ -275,3 +275,86 @@ def test_serve_counters_and_latency_gauges():
     assert "serve/latency_p50_ms" in snap["gauges"]
     assert "serve/latency_p99_ms" in snap["gauges"]
     assert snap["timers"].get("wall/serve/request", 0) > 0
+
+
+# ------------------------------------------------------- concurrency stress
+
+def test_batcher_submit_close_race_no_hung_futures():
+    """N threads hammer submit() across close(): every accepted Future
+    resolves (result or the deterministic closed-drain error), stragglers
+    raise RuntimeError at submit, and nothing hangs. Pre-fix, a submit
+    slipping between close()'s flag flip and the worker's stop marker
+    left its Future pending forever."""
+    import time as _time
+
+    X, y = _data(n=300)
+    bst, _ = _train(X, y, rounds=4)
+    sess = PredictSession(bst, buckets=(64,))
+    sess.warmup((64,))
+    for _trial in range(3):
+        mb = MicroBatcher(sess, max_batch_rows=64, max_wait_ms=0.5)
+        futures, rejected = [], []
+
+        def hammer():
+            while True:
+                try:
+                    futures.append(mb.submit(X[:3]))
+                except RuntimeError:
+                    rejected.append(1)
+                    return
+
+        threads = [threading.Thread(target=hammer) for _ in range(8)]
+        for t in threads:
+            t.start()
+        _time.sleep(0.05)
+        mb.close(timeout=30)
+        for t in threads:
+            t.join(timeout=30)
+            assert not t.is_alive()
+        assert not mb._thread.is_alive()
+        assert rejected, "close() raced in after every hammer thread died?"
+        for fut in futures:
+            # .exception() raises TimeoutError if the Future hung
+            exc = fut.exception(timeout=30)
+            assert exc is None or "closed" in str(exc)
+
+
+def test_train_while_serve_sees_whole_versions():
+    """A serve thread predicts while the main thread keeps training.
+    Every served batch must equal the model at SOME iteration count
+    between the counts observed before and after the predict — a torn
+    pack (half-committed iteration, stale-version cache entry) matches
+    no whole iteration and fails."""
+    X, y = _data(n=300, seed=21)
+    bst, _ = _train(X, y, rounds=2)
+    sess = PredictSession(bst, buckets=(64,))
+    Xq = np.ascontiguousarray(X[:24])
+    observed = []
+    stop = threading.Event()
+
+    def serve():
+        while not stop.is_set() and len(observed) < 400:
+            n0 = len(bst.inner.models)
+            out = np.asarray(sess.raw_scores(Xq), np.float64).ravel()
+            n1 = len(bst.inner.models)
+            observed.append((n0, out, n1))
+
+    th = threading.Thread(target=serve)
+    th.start()
+    try:
+        for _ in range(10):
+            bst.update()
+    finally:
+        stop.set()
+        th.join(timeout=60)
+    assert not th.is_alive()
+    assert observed
+    # prefix raw sums of the final (append-only) model reconstruct the
+    # exact serving surface at every historical iteration count
+    per_tree = np.array([t.predict(Xq) for t in bst.inner.models])
+    prefix = np.vstack([np.zeros((1, len(Xq))), np.cumsum(per_tree, axis=0)])
+    for n0, out, n1 in observed:
+        ok = any(np.allclose(out, prefix[j], rtol=1e-4, atol=1e-5)
+                 for j in range(n0, n1 + 1))
+        assert ok, ("served batch matches no whole model between "
+                    "%d and %d trees" % (n0, n1))
