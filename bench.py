@@ -32,6 +32,9 @@ RANK_ITER = int(os.environ.get("BENCH_RANK_ITERS", 30))
 SKIP_RANK = os.environ.get("BENCH_SKIP_RANK", "") == "1"
 SKIP_2M = os.environ.get("BENCH_SKIP_2M", "") == "1"
 SKIP_SERVE = os.environ.get("BENCH_SKIP_SERVE", "") == "1"
+# non-empty = record host spans (trace_spans=on) and write the flight
+# recorder as Chrome trace-event JSON (Perfetto-loadable) to this path
+TRACE_PATH = os.environ.get("BENCH_TRACE", "")
 
 # reference CPU: Higgs 130.094 s / (500 iter * 10.5M rows); MSLR 70.417 s /
 # (500 * 2.27M)  [BASELINE.md, docs/Experiments.rst:109-123]
@@ -180,6 +183,9 @@ def main():
     import lightgbm_tpu as lgb
     from lightgbm_tpu.utils.timer import global_timer
 
+    if TRACE_PATH:
+        from lightgbm_tpu.obs_trace import tracer
+        tracer.configure("on")
     h_tp, auc, h_train, h_warm, h_gen, h_cons, h_ph = run_higgs(
         lgb, N_ROWS, global_timer)
     result = {
@@ -230,8 +236,14 @@ def main():
             result["serve_value"] = sb["value"]
             result["serve_unit"] = sb["unit"]
             result["serve_vs_naive"] = sb["vs_baseline"]
+            # percentiles derived from the log-bucketed latency histogram
+            # (the same buckets GET /metrics exports); exact cumulative
+            # counts ride along for offline re-aggregation
             result["serve_p50_ms"] = sb["closed_loop_p50_ms"]
+            result["serve_p90_ms"] = sb["closed_loop_p90_ms"]
             result["serve_p99_ms"] = sb["closed_loop_p99_ms"]
+            result["serve_p999_ms"] = sb["closed_loop_p999_ms"]
+            result["serve_hist_buckets"] = sb["closed_loop_hist_buckets"]
         except Exception as e:  # pragma: no cover - report, don't fail
             result["serve_error"] = "%s: %s" % (type(e).__name__,
                                                 str(e)[:200])
@@ -241,6 +253,10 @@ def main():
     # retrace detector verdict, hoisted for headline visibility (PERF.md
     # per-train compile budget; per-entry detail under telemetry)
     result["jit_compiles"] = result["telemetry"]["jit_compiles"]["total"]
+    if TRACE_PATH:
+        from lightgbm_tpu.obs_trace import tracer
+        result["trace_path"] = TRACE_PATH
+        result["trace_events"] = tracer.dump(TRACE_PATH)
     print(json.dumps(result))
 
 

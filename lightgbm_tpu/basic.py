@@ -240,6 +240,13 @@ class Booster:
             self.config = self.inner.config
         else:
             raise LightGBMError("Need train_set, model_file or model_str")
+        # span tracing is process-global: only an EXPLICIT trace_spans
+        # param flips it, so a second Booster built with defaults cannot
+        # silently turn off a tracer something else switched on
+        if "trace_spans" in params:
+            from .obs_trace import tracer
+            tracer.configure(str(params["trace_spans"]),
+                             int(params.get("trace_buffer_events", 0)) or None)
         # loaded models keep their stored best_iteration so predict()
         # defaults to the early-stopped tree count like the reference
         self.best_iteration = self.inner.best_iteration if train_set is None else -1
@@ -285,6 +292,15 @@ class Booster:
         ``auto`` knob resolutions). See :mod:`lightgbm_tpu.obs`."""
         from .obs import telemetry
         return telemetry.snapshot()
+
+    def dump_trace(self, path: str) -> int:
+        """Write the span flight recorder as Chrome trace-event JSON —
+        load the file in Perfetto (ui.perfetto.dev) or chrome://tracing.
+        Spans only record while ``trace_spans=on|serve_only``; returns
+        the number of trace events written. See
+        :mod:`lightgbm_tpu.obs_trace`."""
+        from .obs_trace import tracer
+        return tracer.dump(path)
 
     def eval_train(self, feval=None):
         return self.inner.eval_train(feval)

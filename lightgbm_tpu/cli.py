@@ -24,20 +24,23 @@ from .utils.log import Log, verbosity_to_level
 def parse_args(argv: List[str]) -> Dict[str, Any]:
     """``config=file`` + ``key=value`` overrides
     (reference: application.cpp:52-85 — config file first, CLI wins).
-    One flag-style extra on top of the reference grammar:
+    Two flag-style extras on top of the reference grammar:
     ``--dump-telemetry PATH`` (or ``--dump-telemetry=PATH``) maps to the
-    ``dump_telemetry`` parameter."""
+    ``dump_telemetry`` parameter, ``--dump-trace PATH`` to ``dump_trace``
+    (Chrome trace-event JSON from the span flight recorder)."""
+    flags = {"--dump-telemetry": "dump_telemetry",
+             "--dump-trace": "dump_trace"}
     cli: Dict[str, str] = {}
     argv = list(argv)
     i = 0
     while i < len(argv):
         a = argv[i]
-        if a == "--dump-telemetry" and i + 1 < len(argv):
-            cli["dump_telemetry"] = argv[i + 1].strip()
+        if a in flags and i + 1 < len(argv):
+            cli[flags[a]] = argv[i + 1].strip()
             i += 2
             continue
-        if a.startswith("--dump-telemetry="):
-            cli["dump_telemetry"] = a.split("=", 1)[1].strip()
+        if "=" in a and a.split("=", 1)[0] in flags:
+            cli[flags[a.split("=", 1)[0]]] = a.split("=", 1)[1].strip()
             i += 1
             continue
         if "=" not in a:
@@ -63,6 +66,11 @@ class Application:
         self.raw_params = resolve_aliases(params)
         self.config = Config.from_params(params)
         Log.reset_log_level(verbosity_to_level(self.config.verbosity))
+        # the CLI process owns the span tracer: apply the (validated)
+        # trace_spans mode up front so every task records consistently
+        from .obs_trace import tracer
+        tracer.configure(self.config.trace_spans,
+                         self.config.trace_buffer_events)
 
     def run(self) -> None:
         task = self.config.task
@@ -171,9 +179,9 @@ class Application:
 
     def serve(self) -> None:
         """task=serve: stdlib-HTTP JSON prediction endpoint over a loaded
-        model (POST /predict {"rows": [[...]]}; GET /healthz, /telemetry).
-        Device-resident pack + bucket-ladder compiled predict + request
-        micro-batching — see lightgbm_tpu/serve/."""
+        model (POST /predict {"rows": [[...]]}; GET /healthz, /telemetry,
+        /metrics). Device-resident pack + bucket-ladder compiled predict
+        + request micro-batching — see lightgbm_tpu/serve/."""
         cfg = self.config
         if not cfg.input_model:
             Log.fatal("task=serve requires input_model")
@@ -188,7 +196,13 @@ class Application:
             warmup=cfg.serve_warmup)
         host, port = server.address
         Log.info("Serving %s on http://%s:%d (POST /predict; GET /healthz, "
-                 "/telemetry)", cfg.input_model, host, port)
+                 "/telemetry, /metrics)", cfg.input_model, host, port)
+        stop_dump = None
+        if cfg.dump_telemetry and cfg.telemetry_dump_interval_s > 0:
+            # a wedged server still leaves fresh counters on disk
+            from .obs_trace import start_periodic_telemetry_dump
+            stop_dump = start_periodic_telemetry_dump(
+                cfg.dump_telemetry, cfg.telemetry_dump_interval_s)
         try:
             server.serve_forever()
         except KeyboardInterrupt:
@@ -196,6 +210,8 @@ class Application:
             # serving counters must survive the process
             Log.info("serve: interrupted, shutting down")
         finally:
+            if stop_dump is not None:
+                stop_dump.set()
             server.close()
 
 
@@ -205,13 +221,28 @@ def main(argv: Optional[List[str]] = None) -> None:
         print(__doc__)
         return
     app = Application(parse_args(argv))
+    cfg = app.config
+    if cfg.dump_telemetry or cfg.dump_trace:
+        # SIGUSR1 -> telemetry snapshot, SIGUSR2 -> trace dump, live —
+        # a hung run can be inspected without killing it
+        from .obs_trace import install_signal_handlers
+        try:
+            install_signal_handlers(
+                telemetry_path=cfg.dump_telemetry or None,
+                trace_path=cfg.dump_trace or None)
+        except ValueError:    # not the main thread (embedded use)
+            pass
     app.run()
-    if app.config.dump_telemetry:
+    if cfg.dump_telemetry:
         import json
         from .obs import telemetry
-        with open(app.config.dump_telemetry, "w") as f:
+        with open(cfg.dump_telemetry, "w") as f:
             json.dump(telemetry.snapshot(), f, indent=2)
-        Log.info("Dumped telemetry to %s", app.config.dump_telemetry)
+        Log.info("Dumped telemetry to %s", cfg.dump_telemetry)
+    if cfg.dump_trace:
+        from .obs_trace import tracer
+        n = tracer.dump(cfg.dump_trace)
+        Log.info("Dumped %d trace events to %s", n, cfg.dump_trace)
 
 
 if __name__ == "__main__":

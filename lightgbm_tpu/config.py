@@ -131,8 +131,22 @@ class Config:
     saved_feature_importance_type: int = 0
     snapshot_freq: int = -1
     # write the obs.Telemetry snapshot (JSON) here after the CLI task
-    # finishes; empty = no dump (also settable as --dump-telemetry PATH)
+    # finishes; empty = no dump (also settable as --dump-telemetry PATH).
+    # The CLI additionally dumps to this path on SIGUSR1, and — while
+    # task=serve runs — every telemetry_dump_interval_s seconds, so a
+    # hung server can still be inspected from outside.
     dump_telemetry: str = ""
+    telemetry_dump_interval_s: float = 0.0   # 0 = no periodic serve dump
+
+    # ---- span tracing (obs_trace: host-side flight recorder) ----
+    # off = no spans (zero-cost); on = train phases + serve chain;
+    # serve_only = just the http/batcher/session request chain
+    trace_spans: str = "off"
+    trace_buffer_events: int = 65536  # flight recorder ring capacity
+    # write the Chrome trace-event JSON (Perfetto-loadable) here after
+    # the CLI task finishes; empty = no dump (also --dump-trace PATH,
+    # and on SIGUSR2 while the task runs)
+    dump_trace: str = ""
 
     # ---- linear tree ----
     linear_tree: bool = False
@@ -327,6 +341,15 @@ class Config:
                       self.serve_max_wait_ms)
         if any(b < 1 for b in self.serve_buckets):
             Log.fatal("serve_buckets must be positive row counts")
+        if self.trace_spans not in ("off", "on", "serve_only"):
+            Log.fatal("trace_spans must be off, on or serve_only; got %s",
+                      self.trace_spans)
+        if self.trace_buffer_events < 1:
+            Log.fatal("trace_buffer_events must be >= 1, got %d",
+                      self.trace_buffer_events)
+        if self.telemetry_dump_interval_s < 0:
+            Log.fatal("telemetry_dump_interval_s must be >= 0, got %g",
+                      self.telemetry_dump_interval_s)
         warned = getattr(self, "_noop_warned", None)
         if warned is None:
             warned = set()

@@ -13,7 +13,7 @@ import numpy as np
 from .basic import Booster, Dataset
 from .callback import CallbackEnv, EarlyStopException, early_stopping, log_evaluation
 from .config import resolve_aliases
-from .obs import telemetry
+from .obs import telemetry, trace_phase
 from .utils.log import Log, LightGBMError
 
 
@@ -102,7 +102,8 @@ def train(
         try:
             while scheduled < end:
                 k = min(block, end - scheduled)
-                with global_timer.timed("fused boosting block"):
+                with global_timer.timed("fused boosting block"), \
+                        trace_phase("lgbtpu/train_block"):
                     stopped = booster.inner.train_block(k)
                 if stopped:
                     break
@@ -132,7 +133,8 @@ def train(
         for cb in callbacks_before:
             cb(CallbackEnv(booster, params, it, begin,
                            begin + num_boost_round, None, telemetry))
-        with global_timer.timed("boosting iteration"):
+        with global_timer.timed("boosting iteration"), \
+                trace_phase("lgbtpu/train_iter"):
             stop = booster.update(fobj=fobj)
         # periodic model snapshots for resume (reference: gbdt.cpp:277
         # SaveModelToFile(model.snapshot_iter_N) every snapshot_freq iters)

@@ -13,7 +13,8 @@ interprocedural rules landed):
 - :mod:`.dtypes`    — ``implicit-dtype``, ``dtype-promotion``
 - :mod:`.structure` — ``unnamed-pallas-call``, ``mutable-default``,
   ``module-mutable-state``
-- :mod:`.threads`   — ``lock-discipline`` (thread roots x shared state)
+- :mod:`.threads`   — ``lock-discipline`` (thread roots x shared state),
+  ``unnamed-thread`` (every Thread must be name=d for span traces)
 - :mod:`.tracer`    — ``tracer-leak`` (python control flow on traced values)
 """
 from ..astutil import (  # noqa: F401  (re-exported for rule authors/tests)

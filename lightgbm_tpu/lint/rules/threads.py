@@ -1,4 +1,6 @@
-"""lock-discipline: shared mutable state must have a consistent lock.
+"""Thread rules: lock-discipline and unnamed-thread.
+
+lock-discipline: shared mutable state must have a consistent lock.
 
 The hazard class this encodes is PR 5's: serving threads (the batcher
 worker, one stdlib-HTTP handler thread per connection) share Booster and
@@ -34,8 +36,9 @@ import ast
 import re
 from typing import Dict, FrozenSet, Iterator, List, Optional, Set, Tuple
 
-from ..astutil import own_walk
-from ..core import Finding, Project, Rule, register
+from ..astutil import canonical_call, import_aliases_cached, kwarg_names, \
+    own_walk
+from ..core import Finding, Project, Rule, SourceFile, register
 from ..graph import EXT, FuncInfo, ProjectGraph, graph_for
 
 _LOCK_TYPES = {EXT + "threading.Lock", EXT + "threading.RLock",
@@ -64,6 +67,33 @@ class _Access:
 
 def _fresh_ctor_name(name: str) -> bool:
     return name == "cls" or name.endswith("_cls")
+
+
+@register
+class UnnamedThreadRule(Rule):
+    """``threading.Thread`` without ``name=`` shows up as ``Thread-N`` in
+    the span flight recorder, ``/telemetry`` thread attribution and stack
+    dumps — an anonymous worker is undebuggable once several serve/dump
+    threads coexist (obs_trace keys Chrome-trace thread tracks on the
+    thread name)."""
+
+    id = "unnamed-thread"
+    description = "threading.Thread(...) without a name= (anonymous in " \
+                  "span traces and stack dumps)"
+
+    def check_file(self, f: SourceFile) -> Iterator[Finding]:
+        aliases = import_aliases_cached(f)
+        for node in f.walk_nodes():
+            if not isinstance(node, ast.Call):
+                continue
+            if canonical_call(node, aliases) != "threading.Thread":
+                continue
+            # Thread(group, target, name, ...): a 3rd positional is a name
+            if len(node.args) >= 3 or "name" in kwarg_names(node):
+                continue
+            yield f.finding(node, self.id,
+                            "threading.Thread without name= (worker is "
+                            "anonymous in span traces and stack dumps)")
 
 
 @register

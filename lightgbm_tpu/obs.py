@@ -38,8 +38,10 @@ device sync: telemetry keeps bit-parity with an uninstrumented run.
 from __future__ import annotations
 
 import contextlib
+import re
 import threading
 import time
+from bisect import bisect_left
 from collections import defaultdict
 from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple
 
@@ -147,21 +149,34 @@ def ab_interleaved(fns: Sequence[Tuple[str, Callable[[int], Callable[[], Any]]]]
 
 @contextlib.contextmanager
 def trace_phase(name: str) -> Iterator[None]:
-    """Name a hot-phase region for profiler traces and HLO dumps.
+    """Name a hot-phase region for profiler traces, HLO dumps and — when
+    span tracing is on — the host-side flight recorder.
 
     Inside a jit trace, ``jax.named_scope`` stamps the phase name onto the
     emitted HLO ops; on host, ``jax.profiler.TraceAnnotation`` marks the
     span on the profiler timeline. Both are metadata-only — no runtime
     effect on the computed values, so phase-traced trees stay bit-identical
     (tests/test_obs.py rides the existing parity shapes).
+
+    With ``trace_spans=on`` (obs_trace.tracer), host-side executions of
+    the region additionally record a span into the flight recorder.
+    ``phase_begin`` refuses to record inside a jit trace (that would
+    measure trace time once per compile, not runtime) and is a single
+    attribute read when tracing is off.
     """
     import jax
+    from . import obs_trace
+    sp = obs_trace.tracer.phase_begin(name)
     try:
         ann = jax.profiler.TraceAnnotation(name)
     except Exception:  # pragma: no cover - profiler backend unavailable
         ann = contextlib.nullcontext()
-    with jax.named_scope(name), ann:
-        yield
+    try:
+        with jax.named_scope(name), ann:
+            yield
+    finally:
+        if sp is not None:
+            obs_trace.tracer.end(sp)
 
 
 # ---------------------------------------------------------------------------
@@ -178,20 +193,31 @@ def trace_phase(name: str) -> Iterator[None]:
 _JIT_COMPILES_PREFIX = "jit/compiles/"
 _BACKEND_COMPILES = "jit/backend_compiles"
 _compile_listener_installed = False
+# jax.monitoring listeners cannot be unregistered, so the "already
+# installed" marker must outlive THIS module object: a reloaded obs (or a
+# second copy imported under a different package path) re-running
+# install would otherwise stack a second listener and double every
+# backend-compile count. The sentinel lives on jax.monitoring itself.
+_LISTENER_SENTINEL = "_lightgbm_tpu_compile_listener"
 
 
 def install_compile_listener() -> None:
     """Count every XLA backend compile into ``jit/backend_compiles``.
 
     Uses jax.monitoring's duration listener (fires once per
-    ``backend_compile`` event, including jits we did not wrap). Idempotent;
-    a jax without the monitoring API degrades to a no-op."""
+    ``backend_compile`` event, including jits we did not wrap). Idempotent
+    across repeated calls, repeated Boosters, and module re-imports (the
+    installed marker is a sentinel attribute on ``jax.monitoring``, not
+    only a module global — see tests/test_obs.py). A jax without the
+    monitoring API degrades to a no-op."""
     global _compile_listener_installed
     if _compile_listener_installed:
         return
     _compile_listener_installed = True
     try:
         from jax import monitoring
+        if getattr(monitoring, _LISTENER_SENTINEL, None) is not None:
+            return
 
         def _on_event(event: str, duration: float, **kw) -> None:
             if "backend_compile" in event:
@@ -199,6 +225,7 @@ def install_compile_listener() -> None:
                 telemetry.add_time("jit/backend_compile_s", duration)
 
         monitoring.register_event_duration_secs_listener(_on_event)
+        setattr(monitoring, _LISTENER_SENTINEL, _on_event)
     except Exception:  # pragma: no cover - older jax without monitoring
         pass
 
@@ -279,6 +306,87 @@ def _jsonable(v):
     return repr(v)
 
 
+def _log_bounds(lo: float = 2.0 ** -10, hi: float = 2.0 ** 20,
+                factor: float = 2.0) -> Tuple[float, ...]:
+    """Geometric bucket upper bounds lo, lo*f, ..., >= hi."""
+    bounds = []
+    b = float(lo)
+    while b <= hi * (1 + 1e-12):
+        bounds.append(b)
+        b *= factor
+    return tuple(bounds)
+
+
+# powers of two from ~0.001 to ~1M: one ladder covers latencies in ms
+# (10us..17min) and batch sizes in rows (1..1M) at ~2x resolution
+DEFAULT_HIST_BOUNDS = _log_bounds()
+
+_PCTS = ((0.50, "p50"), (0.90, "p90"), (0.99, "p99"), (0.999, "p999"))
+
+
+class Histogram:
+    """Log-bucketed histogram: exact counts per geometric bucket, with
+    percentiles derived by linear interpolation inside the bucket.
+
+    Replaces the serve latency deque: bounded memory regardless of
+    request count, mergeable across processes, and exportable both as
+    JSON (``snapshot``) and Prometheus ``_bucket{le=...}`` series
+    (:func:`prometheus_text`). NOT internally locked — registry
+    instances are guarded by the Telemetry lock; standalone users (the
+    MicroBatcher window) bring their own.
+    """
+
+    __slots__ = ("bounds", "counts", "count", "sum")
+
+    def __init__(self, bounds: Optional[Sequence[float]] = None) -> None:
+        self.bounds = tuple(bounds) if bounds else DEFAULT_HIST_BOUNDS
+        self.counts = [0] * (len(self.bounds) + 1)   # last = +Inf overflow
+        self.count = 0
+        self.sum = 0.0
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        self.count += 1   # graftlint: guarded-by=_lock -- caller holds it
+        self.sum += v     # graftlint: guarded-by=_lock -- caller holds it
+        self.counts[bisect_left(self.bounds, v)] += 1   # le-inclusive
+
+    def percentile(self, q: float) -> float:
+        """q in [0, 1]; linear interpolation within the hit bucket
+        (Prometheus histogram_quantile semantics)."""
+        if self.count == 0:   # graftlint: guarded-by=_lock
+            return 0.0
+        target = q * self.count   # graftlint: guarded-by=_lock
+        cum, lo = 0, 0.0
+        for i, hi in enumerate(self.bounds):
+            c = self.counts[i]
+            if c > 0 and cum + c >= target:
+                return lo + (hi - lo) * ((target - cum) / c)
+            cum += c
+            lo = hi
+        return self.bounds[-1]   # overflow bucket: clamp to top bound
+
+    def cumulative(self) -> List[Tuple[Any, int]]:
+        """Prometheus-style cumulative buckets: [(le, count<=le), ...,
+        ("+Inf", total)]."""
+        out = []
+        cum = 0
+        for i, b in enumerate(self.bounds):
+            cum += self.counts[i]
+            out.append((b, cum))
+        out.append(("+Inf", self.count))   # graftlint: guarded-by=_lock
+        return out
+
+    def snapshot(self) -> Dict[str, Any]:
+        snap: Dict[str, Any] = {
+            "count": self.count,        # graftlint: guarded-by=_lock
+            "sum": round(self.sum, 6),  # graftlint: guarded-by=_lock
+            "buckets": [[le, c] for le, c in self.cumulative()],
+        }
+        for q, label in _PCTS:
+            snap[label] = round(self.percentile(q), 6)
+        return snap
+
+
 class Telemetry:
     """Process-global registry of counters, gauges, timers and records.
 
@@ -297,6 +405,7 @@ class Telemetry:
         self._timers: Dict[str, float] = defaultdict(float)
         self._timer_calls: Dict[str, int] = defaultdict(int)
         self._records: Dict[str, List[dict]] = defaultdict(list)
+        self._hists: Dict[str, Histogram] = {}
 
     # -- mutation --
     def count(self, name: str, n: int = 1) -> None:
@@ -311,6 +420,16 @@ class Telemetry:
         with self._lock:
             self._timers[name] += float(seconds)
             self._timer_calls[name] += 1
+
+    def observe(self, name: str, value: float,
+                bounds: Optional[Sequence[float]] = None) -> None:
+        """Add one sample to the log-bucketed histogram ``name``
+        (created on first use; ``bounds`` only applies then)."""
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = Histogram(bounds)
+            h.observe(value)
 
     @contextlib.contextmanager
     def timed(self, name: str) -> Iterator[None]:
@@ -343,6 +462,13 @@ class Telemetry:
         with self._lock:
             return list(self._records.get(name, []))
 
+    def histogram(self, name: str) -> Optional[Dict[str, Any]]:
+        """Snapshot of one histogram (buckets + p50/p90/p99/p999), or
+        None when nothing was observed under ``name``."""
+        with self._lock:
+            h = self._hists.get(name)
+            return h.snapshot() if h is not None else None
+
     def snapshot(self, include_global_timer: bool = True) -> Dict[str, Any]:
         """JSON-serializable view of everything recorded so far."""
         with self._lock:
@@ -364,6 +490,8 @@ class Telemetry:
                 },
                 "records": {k: [dict(r) for r in v]
                             for k, v in self._records.items()},
+                "histograms": {k: h.snapshot()
+                               for k, h in self._hists.items()},
             }
         if include_global_timer:
             from .utils.timer import global_timer
@@ -384,6 +512,75 @@ class Telemetry:
             self._timers.clear()
             self._timer_calls.clear()
             self._records.clear()
+            self._hists.clear()
 
 
 telemetry = Telemetry()
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition
+# ---------------------------------------------------------------------------
+
+def _prom_name(name: str) -> str:
+    """Registry key -> legal Prometheus metric name (lgbtpu_ namespace)."""
+    return "lgbtpu_" + re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+
+
+def _prom_num(v) -> str:
+    if isinstance(v, bool):
+        v = int(v)
+    if isinstance(v, int):
+        return str(v)
+    return repr(float(v))
+
+
+def prometheus_text(registry: Optional[Telemetry] = None) -> str:
+    """The registry rendered in Prometheus text exposition format
+    (version 0.0.4): counters as ``_total``, numeric gauges as gauges,
+    timers as ``_seconds_total`` + ``_calls_total`` pairs, histograms as
+    cumulative ``_bucket{le="..."}`` / ``_sum`` / ``_count`` series.
+    Non-numeric gauges (layout strings, auto-knob records) are skipped —
+    they stay on ``/telemetry``. Served by ``GET /metrics`` on
+    :class:`serve.http.PredictServer`."""
+    reg = telemetry if registry is None else registry
+    with reg._lock:
+        counters = dict(reg._counters)
+        gauges = dict(reg._gauges)
+        timers = dict(reg._timers)
+        calls = dict(reg._timer_calls)
+        hists = {k: h.snapshot() for k, h in reg._hists.items()}
+    out: List[str] = []
+    seen = set()
+
+    def emit(name: str, typ: str, lines: List[str]) -> List[str]:
+        if name in seen:   # sanitization collisions: first family wins
+            return []
+        seen.add(name)
+        return ["# TYPE %s %s" % (name, typ)] + lines
+
+    for k in sorted(counters):
+        n = _prom_name(k) + "_total"
+        out += emit(n, "counter", ["%s %s" % (n, _prom_num(counters[k]))])
+    for k in sorted(gauges):
+        v = gauges[k]
+        if not isinstance(v, (bool, int, float)):
+            continue
+        n = _prom_name(k)
+        out += emit(n, "gauge", ["%s %s" % (n, _prom_num(v))])
+    for k in sorted(timers):
+        n = _prom_name(k) + "_seconds_total"
+        out += emit(n, "counter", ["%s %s" % (n, _prom_num(timers[k]))])
+        c = _prom_name(k) + "_calls_total"
+        out += emit(c, "counter", ["%s %s" % (c, _prom_num(calls.get(k, 0)))])
+    for k in sorted(hists):
+        h = hists[k]
+        n = _prom_name(k)
+        lines = []
+        for le, cum in h["buckets"]:
+            le_s = le if isinstance(le, str) else "%g" % le
+            lines.append('%s_bucket{le="%s"} %d' % (n, le_s, cum))
+        lines.append("%s_sum %s" % (n, _prom_num(h["sum"])))
+        lines.append("%s_count %d" % (n, h["count"]))
+        out += emit(n, "histogram", lines)
+    return "\n".join(out) + "\n"

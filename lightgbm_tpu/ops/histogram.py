@@ -29,7 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..obs import track_jit
+from ..obs import trace_phase, track_jit
 
 try:  # pallas is optional at import time (CPU test meshes use XLA paths)
     from jax.experimental import pallas as pl
@@ -470,8 +470,9 @@ def hist16_segment(work: jax.Array, plane, start, cnt, *,
         cgm = cg * valid[:, None].astype(jnp.float32)
         return acc + _hist16_chunk(cb, cgm, num_bins, exact, lo_w)
 
-    # named_scope: metadata-only op annotation for profiler/HLO attribution
-    with jax.named_scope("lgbtpu/ops/hist16_segment"):
+    # trace_phase: metadata-only op annotation for profiler/HLO attribution
+    # (host-side spans refuse to record inside a jit trace)
+    with trace_phase("lgbtpu/ops/hist16_segment"):
         acc = jax.lax.fori_loop(
             0, nchunks, body,
             jnp.zeros((f, sh, lo_w * nch), jnp.float32))
@@ -547,7 +548,7 @@ def hist16_segment_planes(work: jax.Array, plane, start, cnt, *,
         cgm = cg * valid[None, :].astype(jnp.float32)
         return acc + _hist16_chunk_planes(cb, cgm, num_bins, exact, lo_w)
 
-    with jax.named_scope("lgbtpu/ops/hist16_segment_planes"):
+    with trace_phase("lgbtpu/ops/hist16_segment_planes"):
         acc = jax.lax.fori_loop(
             0, nchunks, body,
             jnp.zeros((f, sh, lo_w * nch), jnp.float32))
@@ -591,7 +592,7 @@ def hist16_segment_resident(work: jax.Array, resident: jax.Array, plane,
         cgm = cg * valid[None, :].astype(jnp.float32)
         return acc + _hist16_chunk_planes(cb, cgm, num_bins, exact, lo_w)
 
-    with jax.named_scope("lgbtpu/ops/hist16_segment_resident"):
+    with trace_phase("lgbtpu/ops/hist16_segment_resident"):
         acc = jax.lax.fori_loop(
             0, nchunks, body,
             jnp.zeros((f, sh, lo_w * nch), jnp.float32))

@@ -48,6 +48,8 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
+from ..obs import trace_phase
+
 try:  # pallas is optional at import time (CPU test meshes use the XLA path)
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -200,7 +202,7 @@ def partition_segment(
         work = blend_at(work, rcur - ch, False)
         return work, lcur + nl, rcur - nr
 
-    with jax.named_scope("lgbtpu/ops/partition_segment"):
+    with trace_phase("lgbtpu/ops/partition_segment"):
         work, lcur, _ = jax.lax.fori_loop(
             0, nchunks, body, (work, start, start + cnt))
         return work, lcur - start
@@ -308,7 +310,7 @@ def partition_segment_planes(
         work = blend_at(work, rcur - ch, False)
         return work, lcur + nl, rcur - nr
 
-    with jax.named_scope("lgbtpu/ops/partition_segment_planes"):
+    with trace_phase("lgbtpu/ops/partition_segment_planes"):
         work, lcur, _ = jax.lax.fori_loop(
             0, nchunks, body, (work, start, start + cnt))
         return work, lcur - start

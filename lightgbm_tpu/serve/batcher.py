@@ -17,7 +17,6 @@ from __future__ import annotations
 
 import queue
 import threading
-from collections import deque
 from concurrent.futures import Future
 from typing import Optional
 
@@ -25,18 +24,20 @@ import numpy as np
 
 from .. import obs
 from ..obs import telemetry
+from ..obs_trace import tracer
 
 _STOP = object()
 
 
 class _Request:
-    __slots__ = ("X", "rows", "future", "t0")
+    __slots__ = ("X", "rows", "future", "t0", "trace_id")
 
-    def __init__(self, X: np.ndarray) -> None:
+    def __init__(self, X: np.ndarray, trace_id: Optional[int] = None) -> None:
         self.X = X
         self.rows = X.shape[0]
         self.future: Future = Future()
         self.t0 = obs.monotonic()
+        self.trace_id = trace_id
 
 
 class MicroBatcher:
@@ -60,27 +61,38 @@ class MicroBatcher:
         self._q: "queue.SimpleQueue" = queue.SimpleQueue()
         # one lock, two jobs: (a) makes submit's closed-check atomic with
         # the enqueue so no request can slip in behind close()'s _STOP and
-        # hang its Future forever; (b) guards the latency deque, which the
-        # worker appends to while callers read latency_stats()
+        # hang its Future forever; (b) guards the latency histogram, which
+        # the worker feeds while callers read latency_stats()
         self._lock = threading.Lock()
-        self._lat: deque = deque(maxlen=int(latency_window))
+        # log-bucketed histogram over submit->delivery latency in ms:
+        # bounded memory at any request count, exact bucket counts for
+        # /metrics; also mirrored into the global registry under
+        # serve/latency_ms. latency_window is kept for signature compat
+        # with the old deque-based stats and is ignored.
+        del latency_window
+        self._hist = obs.Histogram()
         self._closed = False
         self._thread = threading.Thread(
             target=self._worker, name="lgbtpu-serve-batcher", daemon=True)
         self._thread.start()
 
     # ---------------------------------------------------------------- submit
-    def submit(self, X) -> Future:
+    def submit(self, X, trace_id: Optional[int] = None) -> Future:
         """Queue one request; returns a Future resolving to its predictions
         (same shapes as ``PredictSession.predict``). A 1-D row is treated
-        as a single-row batch. Raises ``RuntimeError`` once the batcher is
-        closed — atomically with close(), so a submit either lands before
-        the worker's stop marker (and gets an answer or a deterministic
-        'closed' failure from the drain) or raises here; it never hangs."""
+        as a single-row batch. ``trace_id`` (from the http handler) links
+        this request's queue/coalesce/dispatch spans to its request span
+        when span tracing is on. Raises ``RuntimeError`` once the batcher
+        is closed — atomically with close(), so a submit either lands
+        before the worker's stop marker (and gets an answer or a
+        deterministic 'closed' failure from the drain) or raises here; it
+        never hangs."""
         X = np.asarray(X, np.float64)
         if X.ndim == 1:
             X = X[None, :]
-        req = _Request(X)
+        if trace_id is None and tracer.serve_on:
+            trace_id = tracer.new_trace_id()
+        req = _Request(X, trace_id)
         with self._lock:
             if self._closed:
                 raise RuntimeError("MicroBatcher is closed")
@@ -99,6 +111,7 @@ class MicroBatcher:
                 break
             batch = [req]
             rows = req.rows
+            t_first = obs.monotonic()    # lead request leaves the queue
             deadline = req.t0 + self._max_wait
             while rows < self._max_rows:
                 # requests already queued join for free — draining them
@@ -121,23 +134,40 @@ class MicroBatcher:
                 batch.append(nxt)
                 rows += nxt.rows
             telemetry.gauge("serve/queue_depth", self._q.qsize())
+            if tracer.serve_on:
+                # retroactive spans: each request's time-in-queue (submit
+                # until its batch was sealed) plus one coalesce span for
+                # the assembly window itself
+                now = obs.monotonic()
+                for r in batch:
+                    tracer.record("serve/queue_wait", r.t0, now,
+                                  trace_id=r.trace_id)
+                tracer.record("serve/coalesce", t_first, now,
+                              trace_id=batch[0].trace_id,
+                              args={"requests": len(batch), "rows": rows})
             self._run_batch(batch)
         self._drain()
 
     def _run_batch(self, batch) -> None:
+        n_rows = sum(r.rows for r in batch)
         telemetry.count("serve/batches")
-        telemetry.count("serve/batch_rows", sum(r.rows for r in batch))
+        telemetry.count("serve/batch_rows", n_rows)
+        telemetry.observe("serve/batch_rows", n_rows)
         try:
-            X = batch[0].X if len(batch) == 1 else \
-                np.concatenate([r.X for r in batch], axis=0)
-            with obs.wall("serve/batch"):
-                pieces = self._session.dispatch(X)
-                # the serve path's one sanctioned device->host sync: pull
-                # the coalesced scores for result delivery
-                host = [np.asarray(s, np.float64)[:r]  # graftlint: disable=host-sync
-                        for s, r in pieces]
-            raw = host[0] if len(host) == 1 else np.concatenate(host)
-            out = self._session.finalize(raw, raw_score=self._raw)
+            with tracer.span("serve/batch", domain="serve",
+                             trace_id=batch[0].trace_id,
+                             requests=len(batch), rows=n_rows):
+                X = batch[0].X if len(batch) == 1 else \
+                    np.concatenate([r.X for r in batch], axis=0)
+                with obs.wall("serve/batch"):
+                    pieces = self._session.dispatch(X)
+                    # the serve path's one sanctioned device->host sync:
+                    # pull the coalesced scores for result delivery
+                    with tracer.span("serve/slice_back", domain="serve"):
+                        host = [np.asarray(s, np.float64)[:r]  # graftlint: disable=host-sync
+                                for s, r in pieces]
+                raw = host[0] if len(host) == 1 else np.concatenate(host)
+                out = self._session.finalize(raw, raw_score=self._raw)
         except BaseException as exc:
             for r in batch:
                 if not r.future.done():
@@ -150,30 +180,33 @@ class MicroBatcher:
             off += r.rows
             dt = now - r.t0
             with self._lock:
-                self._lat.append(dt)
+                self._hist.observe(dt * 1000.0)
+            telemetry.observe("serve/latency_ms", dt * 1000.0)
             telemetry.add_time("wall/serve/request", dt)
         self._update_latency_gauges()
 
     def _update_latency_gauges(self) -> None:
         with self._lock:
-            if not self._lat:
+            if self._hist.count == 0:
                 return
-            ms = np.asarray(self._lat, np.float64) * 1000.0
-        telemetry.gauge("serve/latency_p50_ms",
-                        round(float(np.percentile(ms, 50)), 4))
-        telemetry.gauge("serve/latency_p99_ms",
-                        round(float(np.percentile(ms, 99)), 4))
+            p50 = self._hist.percentile(0.50)
+            p99 = self._hist.percentile(0.99)
+        telemetry.gauge("serve/latency_p50_ms", round(p50, 4))
+        telemetry.gauge("serve/latency_p99_ms", round(p99, 4))
 
     def latency_stats(self) -> dict:
-        """p50/p99/count over the sliding latency window (seconds)."""
+        """count + p50/p90/p99/p999 (seconds) derived from the latency
+        histogram buckets (bucket-interpolated, not exact order stats)."""
         with self._lock:
-            lat = sorted(self._lat)
-        if not lat:
-            return {"count": 0, "p50_s": 0.0, "p99_s": 0.0}
-        arr = np.asarray(lat, np.float64)
-        return {"count": len(lat),
-                "p50_s": float(np.percentile(arr, 50)),
-                "p99_s": float(np.percentile(arr, 99))}
+            n = self._hist.count
+            pcts = {label: self._hist.percentile(q) / 1000.0
+                    for q, label in obs._PCTS}
+        if n == 0:
+            return {"count": 0, "p50_s": 0.0, "p90_s": 0.0,
+                    "p99_s": 0.0, "p999_s": 0.0}
+        return {"count": n,
+                "p50_s": pcts["p50"], "p90_s": pcts["p90"],
+                "p99_s": pcts["p99"], "p999_s": pcts["p999"]}
 
     # -------------------------------------------------------------- shutdown
     def _drain(self) -> None:

@@ -7,7 +7,10 @@ Compares three ways of answering the same request stream:
 - **open loop**: submit every request to a MicroBatcher at once, gather
   futures — measures coalesced throughput (requests/s, rows/s);
 - **closed loop**: one request in flight at a time — measures per-request
-  latency (p50/p99) including the batcher's ``max_wait_ms`` deadline.
+  latency including the batcher's ``max_wait_ms`` deadline. Percentiles
+  (p50/p90/p99/p999) come from an obs.Histogram's log buckets — the same
+  representation ``/metrics`` exports — and the exact cumulative bucket
+  counts ride along in the JSON.
 
 Parity between naive and served predictions is asserted IN-RUN (the bench
 refuses to report a speedup over wrong answers). Timing uses obs.wall;
@@ -22,6 +25,21 @@ import numpy as np
 
 from .. import obs
 from ..obs import telemetry
+
+
+def _trim_buckets(buckets):
+    """Drop the all-zero prefix and the saturated suffix of cumulative
+    [le, count] pairs so the JSON shows only the populated range (the
+    +Inf terminator always stays)."""
+    total = buckets[-1][1]
+    out = [[le, c] for le, c in buckets[:-1] if 0 < c <= total]
+    keep = []
+    for le, c in out:
+        keep.append([le, c])
+        if c == total:
+            break
+    keep.append(list(buckets[-1]))
+    return keep
 
 
 def _make_data(n: int, f: int, seed: int):
@@ -71,11 +89,11 @@ def run_serve_bench(*, requests: int = 512, rows_per_request: int = 1,
             futs = [mb.submit(r) for r in reqs]
             served = [f.result(timeout=120) for f in futs]
         open_s = max(w.seconds, 1e-9)
-        closed_lat = []
+        closed_hist = obs.Histogram()
         for r in reqs[:closed_loop_requests]:
             t0 = obs.monotonic()
             mb.submit(r).result(timeout=120)
-            closed_lat.append(obs.monotonic() - t0)
+            closed_hist.observe((obs.monotonic() - t0) * 1000.0)
 
     # -- parity asserted in-run: a fast wrong answer is not a result --
     flat_naive = np.concatenate([np.atleast_1d(p) for p in naive])
@@ -86,7 +104,7 @@ def run_serve_bench(*, requests: int = 512, rows_per_request: int = 1,
 
     total_rows = requests * rows_per_request
     speedup = naive_s / open_s
-    lat = np.asarray(closed_lat, np.float64) * 1000.0
+    chist = closed_hist.snapshot()
     result = {
         "metric": "serve_open_loop_throughput",
         "value": round(total_rows / open_s, 2),
@@ -99,8 +117,15 @@ def run_serve_bench(*, requests: int = 512, rows_per_request: int = 1,
         "naive_s": round(naive_s, 4),
         "open_loop_s": round(open_s, 4),
         "open_loop_requests_per_s": round(requests / open_s, 2),
-        "closed_loop_p50_ms": round(float(np.percentile(lat, 50)), 3),
-        "closed_loop_p99_ms": round(float(np.percentile(lat, 99)), 3),
+        "closed_loop_p50_ms": round(chist["p50"], 3),
+        "closed_loop_p90_ms": round(chist["p90"], 3),
+        "closed_loop_p99_ms": round(chist["p99"], 3),
+        "closed_loop_p999_ms": round(chist["p999"], 3),
+        # cumulative [le, count] pairs, trimmed to the populated range
+        "closed_loop_hist_buckets": _trim_buckets(chist["buckets"]),
+        # the batcher's own submit->delivery histogram (open + closed
+        # loop requests), as served by /metrics
+        "serve_latency_hist": telemetry.histogram("serve/latency_ms"),
         "parity_max_abs_err": parity,
         "serve_counters": {
             k: v for k, v in telemetry.snapshot()["counters"].items()

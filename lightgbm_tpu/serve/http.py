@@ -4,7 +4,14 @@
                     -> {"predictions": [...], "rows": n}
     GET  /healthz   liveness + model/bucket info
     GET  /telemetry full obs.Telemetry snapshot (serve/* counters, jit
-                    compile counts, latency gauges)
+                    compile counts, latency gauges + histograms)
+    GET  /metrics   the registry in Prometheus text exposition format
+                    (latency/batch-size histogram buckets included)
+
+With span tracing on (``trace_spans=on|serve_only``), each POST opens a
+``serve/http_request`` span carrying a fresh trace id that the batcher
+threads through queue_wait -> coalesce -> batch -> session_dispatch ->
+slice_back, so one request yields a full chain in the flight recorder.
 
 ``ThreadingHTTPServer`` gives one handler thread per connection, so
 concurrent POSTs land in the MicroBatcher together and coalesce into one
@@ -18,7 +25,9 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from .. import obs
 from ..obs import telemetry
+from ..obs_trace import tracer
 from ..utils.log import Log
 from .batcher import MicroBatcher
 from .session import PredictSession
@@ -69,6 +78,14 @@ class PredictServer:
                     })
                 elif self.path == "/telemetry":
                     self._json(200, telemetry.snapshot())
+                elif self.path == "/metrics":
+                    body = obs.prometheus_text().encode("utf-8")
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
                 else:
                     self._json(404, {"error": "unknown path %s" % self.path})
 
@@ -83,8 +100,11 @@ class PredictServer:
                     X = np.asarray(rows, np.float64)
                     if X.ndim == 1:
                         X = X[None, :]
-                    fut = server.batcher.submit(X)
-                    out = fut.result(timeout=server.request_timeout_s)
+                    tid = tracer.new_trace_id() if tracer.serve_on else None
+                    with tracer.span("serve/http_request", domain="serve",
+                                     trace_id=tid, rows=int(X.shape[0])):
+                        fut = server.batcher.submit(X, trace_id=tid)
+                        out = fut.result(timeout=server.request_timeout_s)
                     self._json(200, {"predictions": out.tolist(),
                                      "rows": int(X.shape[0])})
                 except Exception as exc:

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..obs import telemetry, track_jit
+from ..obs_trace import tracer
 from ..ops.predict import predict_raw_impl
 from ..utils.log import LightGBMError
 
@@ -147,21 +148,25 @@ class PredictSession:
             return pieces
         top = self.buckets[-1]
         telemetry.count("serve/dispatches")
-        for lo in range(0, n, top):
-            chunk = X[lo:lo + top]
-            rows = chunk.shape[0]
-            b = self.bucket_for(rows)
-            with self._lock:
-                warm = b in self._warm
-                self._warm.add(b)
-            telemetry.count("serve/bucket_hit" if warm else "serve/bucket_miss")
-            if b > rows:
-                telemetry.count("serve/pad_rows", b - rows)
-                chunk = np.concatenate(
-                    [chunk, np.zeros((b - rows, nf), np.float32)])
-            score = _predict_bucket(jnp.asarray(chunk), pack,
-                                    num_class=self._K, has_cat=has_cat)
-            pieces.append((score, rows))
+        # async dispatch only — the span ends when every chunk is queued,
+        # not when the device finishes (that wait is serve/slice_back)
+        with tracer.span("serve/session_dispatch", domain="serve", rows=n):
+            for lo in range(0, n, top):
+                chunk = X[lo:lo + top]
+                rows = chunk.shape[0]
+                b = self.bucket_for(rows)
+                with self._lock:
+                    warm = b in self._warm
+                    self._warm.add(b)
+                telemetry.count(
+                    "serve/bucket_hit" if warm else "serve/bucket_miss")
+                if b > rows:
+                    telemetry.count("serve/pad_rows", b - rows)
+                    chunk = np.concatenate(
+                        [chunk, np.zeros((b - rows, nf), np.float32)])
+                score = _predict_bucket(jnp.asarray(chunk), pack,
+                                        num_class=self._K, has_cat=has_cat)
+                pieces.append((score, rows))
         return pieces
 
     def warmup(self, buckets: Optional[Sequence[int]] = None) -> "PredictSession":

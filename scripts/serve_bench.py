@@ -6,9 +6,12 @@ Usage:
     python scripts/serve_bench.py --no-assert   # report without the >=5x gate
 
 Prints ONE JSON line (bench.py style): open-loop rows/s as the headline
-metric, vs_baseline = speedup over the naive loop, closed-loop p50/p99
-latency, the in-run parity error, and the serve/* telemetry counters.
-Exits non-zero when the speedup gate fails (parity is always asserted).
+metric, vs_baseline = speedup over the naive loop, closed-loop
+p50/p90/p99/p999 latency derived from log-bucketed histogram counts
+(the buckets themselves ride along in the JSON), the in-run parity
+error, and the serve/* telemetry counters. ``--trace PATH`` records the
+serve span chain and writes a Perfetto-loadable Chrome trace. Exits
+non-zero when the speedup gate fails (parity is always asserted).
 """
 import argparse
 import json
@@ -34,6 +37,9 @@ def main(argv=None) -> int:
     ap.add_argument("--max-wait-ms", type=float, default=2.0)
     ap.add_argument("--no-assert", action="store_true",
                     help="report the speedup without gating on >=5x")
+    ap.add_argument("--trace", default=None, metavar="PATH",
+                    help="record serve spans; write Chrome trace-event "
+                         "JSON (Perfetto-loadable) to PATH")
     args = ap.parse_args(argv)
 
     if args.quick:
@@ -52,6 +58,9 @@ def main(argv=None) -> int:
         preset["n_features"] = args.features
 
     from lightgbm_tpu.serve.bench import run_serve_bench
+    if args.trace:
+        from lightgbm_tpu.obs_trace import tracer
+        tracer.configure("serve_only")
     try:
         result = run_serve_bench(
             rows_per_request=args.rows_per_request,
@@ -62,6 +71,9 @@ def main(argv=None) -> int:
     except AssertionError as exc:
         print(json.dumps({"error": str(exc)}))
         return 1
+    if args.trace:
+        result["trace_path"] = args.trace
+        result["trace_events"] = tracer.dump(args.trace)
     print(json.dumps(result))
     return 0
 

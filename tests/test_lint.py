@@ -569,6 +569,31 @@ def test_lock_discipline_init_only_writes_are_clean(tmp_path):
     assert "lock-discipline" not in rules_hit(res)
 
 
+# ------------------------------------------------------------ unnamed-thread
+
+def test_unnamed_thread_positive(tmp_path):
+    res = make_project(tmp_path, {"lightgbm_tpu/w.py": """\
+        import threading
+        from threading import Thread
+
+        t1 = threading.Thread(target=print)
+        t2 = Thread(target=print, daemon=True)
+    """})
+    assert lines_hit(res, "unnamed-thread") == [4, 5]
+
+
+def test_unnamed_thread_negative(tmp_path):
+    res = make_project(tmp_path, {"lightgbm_tpu/w.py": """\
+        import threading
+
+        t1 = threading.Thread(target=print, name="lgbtpu-worker")
+        t2 = threading.Thread(None, print, "lgbtpu-pos-name")
+        t3 = threading.Timer(1.0, print)    # not a Thread constructor
+        local = threading.local()
+    """})
+    assert "unnamed-thread" not in rules_hit(res)
+
+
 # ------------------------------------------------------------ tracer-leak
 
 def test_tracer_leak_positive(tmp_path):

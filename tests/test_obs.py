@@ -9,6 +9,7 @@ the migrated timing harnesses.
 """
 import json
 import os
+import re
 import threading
 
 import numpy as np
@@ -86,6 +87,123 @@ def test_ab_interleaved_protocol():
         obs.ab_interleaved([("x", make)], k=1)
     res = obs.ab_interleaved([("x", make)], reps=2, k=3)
     assert set(res) == {"x"} and np.isfinite(res["x"])
+
+
+# ------------------------------------------------------------ histograms
+
+def test_histogram_buckets_and_percentiles():
+    h = obs.Histogram(bounds=(1.0, 2.0, 4.0, 8.0))
+    for v in (0.5, 1.0, 3.0, 3.5, 100.0):
+        h.observe(v)
+    assert h.count == 5 and h.sum == 108.0
+    # le-inclusive buckets: 1.0 lands in le=1, 100 overflows to +Inf
+    assert h.cumulative() == [(1.0, 2), (2.0, 2), (4.0, 4), (8.0, 4),
+                              ("+Inf", 5)]
+    assert h.percentile(0.0) == 0.0 or h.percentile(0.0) <= 1.0
+    assert 2.0 <= h.percentile(0.6) <= 4.0    # interpolated in (2, 4]
+    assert h.percentile(1.0) == 8.0           # overflow clamps to top bound
+    snap = h.snapshot()
+    assert snap["count"] == 5
+    assert snap["buckets"][-1] == ["+Inf", 5]
+    assert set(snap) >= {"p50", "p90", "p99", "p999", "sum"}
+    json.dumps(snap)
+    # cumulative counts never decrease (Prometheus invariant)
+    cums = [c for _, c in h.cumulative()]
+    assert cums == sorted(cums)
+
+
+def test_registry_histograms_in_snapshot():
+    t = Telemetry()
+    for v in (1.0, 5.0, 50.0):
+        t.observe("lat_ms", v)
+    snap = t.snapshot(include_global_timer=False)
+    assert snap["histograms"]["lat_ms"]["count"] == 3
+    assert t.histogram("lat_ms")["count"] == 3
+    assert t.histogram("nope") is None
+    t.reset()
+    assert t.snapshot(include_global_timer=False)["histograms"] == {}
+
+
+_PROM_SAMPLE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{le="[^"]+"\})? (-?[0-9.eE+\-]+|[0-9]+)$')
+_PROM_TYPE = re.compile(
+    r"^# TYPE ([a-zA-Z_:][a-zA-Z0-9_:]*) (counter|gauge|histogram)$")
+
+
+def parse_prometheus(text):
+    """Strict line parser for text exposition 0.0.4: returns
+    {family: type} and {sample_name(+labels): float}."""
+    families, samples = {}, {}
+    assert text.endswith("\n")
+    for line in text.rstrip("\n").split("\n"):
+        m = _PROM_TYPE.match(line)
+        if m:
+            assert m.group(1) not in families, "duplicate family"
+            families[m.group(1)] = m.group(2)
+            continue
+        m = _PROM_SAMPLE.match(line)
+        assert m, "unparseable exposition line: %r" % line
+        samples[m.group(1) + (m.group(2) or "")] = float(m.group(3))
+    return families, samples
+
+
+def test_prometheus_text_renders_all_kinds():
+    t = Telemetry()
+    t.count("serve/requests", 3)
+    t.gauge("serve/queue_depth", 2)
+    t.gauge("layout", "rows-major")            # non-numeric: skipped
+    t.add_time("wall/serve", 0.5)
+    t.observe("serve/latency_ms", 3.0)
+    t.observe("serve/latency_ms", 700.0)
+    text = obs.prometheus_text(t)
+    families, samples = parse_prometheus(text)
+    assert families["lgbtpu_serve_requests_total"] == "counter"
+    assert families["lgbtpu_serve_queue_depth"] == "gauge"
+    assert families["lgbtpu_wall_serve_seconds_total"] == "counter"
+    assert families["lgbtpu_serve_latency_ms"] == "histogram"
+    assert "lgbtpu_layout" not in families
+    assert samples["lgbtpu_serve_requests_total"] == 3
+    assert samples["lgbtpu_wall_serve_calls_total"] == 1
+    assert samples['lgbtpu_serve_latency_ms_bucket{le="+Inf"}'] == 2
+    assert samples["lgbtpu_serve_latency_ms_count"] == 2
+    assert samples["lgbtpu_serve_latency_ms_sum"] == 703.0
+    # cumulative bucket series is monotone in le order
+    buckets = [(k, v) for k, v in samples.items()
+               if k.startswith("lgbtpu_serve_latency_ms_bucket")]
+    vals = [v for _, v in buckets]
+    assert vals == sorted(vals) and vals[-1] == 2
+
+
+def test_prometheus_name_collision_first_family_wins():
+    t = Telemetry()
+    t.count("a/b", 1)
+    t.count("a.b", 5)          # sanitizes to the same family name
+    families, samples = parse_prometheus(obs.prometheus_text(t))
+    assert families["lgbtpu_a_b_total"] == "counter"
+    # keys render in sorted order, so "a.b" is emitted first and wins
+    assert samples["lgbtpu_a_b_total"] == 5
+
+
+def test_compile_listener_install_is_idempotent():
+    import jax
+    import jax.numpy as jnp
+
+    obs.install_compile_listener()
+    # simulate a module re-import losing the module-global flag: the
+    # sentinel on jax.monitoring must still prevent a second listener
+    obs._compile_listener_installed = False
+    obs.install_compile_listener()
+    assert obs._compile_listener_installed
+    telemetry.reset()
+
+    @jax.jit
+    def _fresh(x):
+        return x * 3.0 + 1.0
+
+    _fresh(np.arange(11.0)).block_until_ready()
+    c = telemetry.snapshot(include_global_timer=False)["counters"]
+    # a doubled listener would count 2 per compile
+    assert c.get("jit/backend_compiles", 0) == 1
 
 
 # ------------------------------------------------------------- hot path

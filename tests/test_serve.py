@@ -254,6 +254,64 @@ def test_http_server_roundtrip():
         server.close()
 
 
+def test_http_metrics_prometheus_and_telemetry_histograms():
+    """GET /metrics parses as Prometheus text exposition (incl. latency
+    histogram buckets); GET /telemetry carries the histogram sections."""
+    import json
+    from urllib.request import Request, urlopen
+    from test_obs import parse_prometheus
+
+    X, y = _data(seed=15)
+    bst, _ = _train(X, y)
+    server = PredictServer(bst, port=0, buckets=(64,), warmup=True,
+                           max_wait_ms=1.0)
+    host, port = server.address
+    thread = threading.Thread(target=server.serve_forever, daemon=True,
+                              name="serve-test-metrics")
+    thread.start()
+    try:
+        body = json.dumps({"rows": X[:4].tolist()}).encode()
+        req = Request("http://%s:%d/predict" % (host, port), data=body,
+                      headers={"Content-Type": "application/json"})
+        with urlopen(req, timeout=30) as resp:
+            assert json.loads(resp.read())["rows"] == 4
+        with urlopen("http://%s:%d/metrics" % (host, port), timeout=30) as r:
+            assert r.headers["Content-Type"].startswith("text/plain")
+            text = r.read().decode("utf-8")
+        families, samples = parse_prometheus(text)
+        assert families["lgbtpu_serve_requests_total"] == "counter"
+        assert families["lgbtpu_serve_latency_ms"] == "histogram"
+        assert samples['lgbtpu_serve_latency_ms_bucket{le="+Inf"}'] >= 1
+        assert samples["lgbtpu_serve_latency_ms_count"] >= 1
+        assert samples["lgbtpu_serve_batch_rows_count"] >= 1
+        with urlopen("http://%s:%d/telemetry" % (host, port), timeout=30) as r:
+            snap = json.loads(r.read())
+        hists = snap["histograms"]
+        assert hists["serve/latency_ms"]["count"] >= 1
+        assert hists["serve/latency_ms"]["buckets"][-1][0] == "+Inf"
+        assert hists["serve/batch_rows"]["count"] >= 1
+    finally:
+        server.shutdown()
+        thread.join(timeout=10)
+        server.close()
+
+
+def test_batcher_latency_stats_from_histogram():
+    X, y = _data(seed=16)
+    bst, _ = _train(X, y)
+    sess = PredictSession(bst, buckets=(64,))
+    with MicroBatcher(sess, max_wait_ms=1.0) as mb:
+        assert mb.latency_stats()["count"] == 0
+        for i in range(5):
+            mb.submit(X[i:i + 1]).result(timeout=60)
+        stats = mb.latency_stats()
+    assert stats["count"] == 5
+    assert 0 < stats["p50_s"] <= stats["p90_s"] <= stats["p99_s"] \
+        <= stats["p999_s"]
+    # gauges derived from the same buckets land in the registry
+    assert obs.telemetry.snapshot()["gauges"]["serve/latency_p50_ms"] > 0
+
+
 # ------------------------------------------------------------------ counters
 
 def test_serve_counters_and_latency_gauges():
