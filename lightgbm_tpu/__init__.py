@@ -23,6 +23,7 @@ try:  # full API surface; modules come online as the build proceeds
     from .basic import Booster, Dataset, register_logger
     from .engine import train, cv, CVBooster
     from . import serve  # noqa: F401 — lgb.serve.PredictSession et al.
+    from . import online  # noqa: F401 — lgb.online.OnlineTrainer et al.
     from .plotting import (  # noqa: F401
         create_tree_digraph,
         plot_importance,

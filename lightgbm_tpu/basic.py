@@ -421,25 +421,42 @@ class Booster:
             from .utils.log import Log
             Log.fatal("refit with a ranking objective requires group=")
         obj.init(meta)
-        for i, tree in enumerate(new_booster.inner.models):
-            leaf_idx = tree.predict_leaf_index(X)
-            # grad at current score for this class
-            import jax.numpy as jnp
-            s = jnp.asarray(score if K > 1 else score.ravel(), jnp.float32)
-            g, h = obj.get_gradients(s)
-            g = np.asarray(g).reshape(len(y), -1)[:, i % K]
-            h = np.asarray(h).reshape(len(y), -1)[:, i % K]
-            lam = new_booster.config.lambda_l2
-            for l in range(tree.num_leaves):
-                m = leaf_idx == l
-                if np.any(m):
-                    new_val = -g[m].sum() / (h[m].sum() + lam)
-                    tree.leaf_value[l] = decay * tree.leaf_value[l] + \
-                        (1 - decay) * new_val * tree.shrinkage
-            score[:, i % K] += tree.predict(X)
-        # leaf values were rewritten in place on the fresh booster's trees
-        new_booster.inner._bump_model_version()
+        # the candidate is private to this call, but refit also runs on the
+        # OnlineTrainer worker thread — rewrite its leaves under its model
+        # lock so the leaf-value mutations and the final version bump land
+        # as one committed model for any session handed the candidate
+        with new_booster.inner._cache_lock:
+            for i, tree in enumerate(new_booster.inner.models):
+                leaf_idx = tree.predict_leaf_index(X)
+                # grad at current score for this class
+                import jax.numpy as jnp
+                s = jnp.asarray(score if K > 1 else score.ravel(), jnp.float32)
+                g, h = obj.get_gradients(s)
+                g = np.asarray(g).reshape(len(y), -1)[:, i % K]
+                h = np.asarray(h).reshape(len(y), -1)[:, i % K]
+                lam = new_booster.config.lambda_l2
+                for l in range(tree.num_leaves):
+                    m = leaf_idx == l
+                    if np.any(m):
+                        new_val = -g[m].sum() / (h[m].sum() + lam)
+                        tree.leaf_value[l] = decay * tree.leaf_value[l] + \
+                            (1 - decay) * new_val * tree.shrinkage
+                score[:, i % K] += tree.predict(X)
+            # leaf values were rewritten in place on the fresh booster's trees
+            new_booster.inner._bump_model_version()
         return new_booster
+
+    def adopt(self, other: "Booster") -> tuple:
+        """Atomically swap this booster's served model for ``other``'s
+        (online promotion: single version bump under the model lock, so
+        concurrent PredictSessions see old-or-new, never a mix). Returns
+        a rollback token for :meth:`restore`."""
+        return self.inner.adopt(getattr(other, "inner", other))
+
+    def restore(self, snapshot: tuple) -> "Booster":
+        """Roll back to a model captured by :meth:`adopt`."""
+        self.inner.restore(snapshot)
+        return self
 
 
 def register_logger(logger) -> None:

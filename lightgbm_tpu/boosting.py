@@ -598,6 +598,40 @@ class GBDT:
         with self._cache_lock:
             self._model_version += 1
 
+    # ------------------------------------------------------- hot swap (online)
+    def adopt(self, other: "GBDT") -> tuple:
+        """Atomically swap this booster's served model for ``other``'s.
+
+        The online promotion hook: a candidate trained off the serving
+        thread (refit / continued training) replaces the resident model
+        under the model lock with a SINGLE version bump, so every
+        concurrent PredictSession snapshot sees either the old ensemble
+        or the new one whole — never a half-committed pack. Scores and
+        validation trackers are NOT rebuilt (serving boosters have no
+        training state to keep consistent; call _rebuild_scores yourself
+        if you adopt into a live training booster).
+
+        Returns an opaque rollback token for :meth:`restore`.
+        """
+        with self._cache_lock:
+            snap = (list(self.models), self.init_scores.copy(), self.iter_)
+            self.models = list(other.models)
+            self.init_scores = np.asarray(other.init_scores,
+                                          np.float64).copy()
+            self.iter_ = int(other.iter_)
+            self._bump_model_version()
+        return snap
+
+    def restore(self, snapshot: tuple) -> None:
+        """Roll back to a model captured by :meth:`adopt` (same single
+        version-bump atomicity as the promotion itself)."""
+        models, init_scores, it = snapshot
+        with self._cache_lock:
+            self.models = list(models)
+            self.init_scores = np.asarray(init_scores, np.float64).copy()
+            self.iter_ = int(it)
+            self._bump_model_version()
+
     def _packed_model(self, start: int, end: int):
         """Device-resident ``PackedSplits`` for iterations [start, end).
 
@@ -695,7 +729,10 @@ class GBDT:
         """
         K = self.num_tree_per_iteration
         n = X.shape[0]
-        models = self.models[start * K:end * K]
+        # snapshot under the model lock: the online trainer shadow-scores
+        # candidates from its worker thread while promotions mutate models
+        with self._cache_lock:
+            models = self.models[start * K:end * K]
         has_linear = any(getattr(t, "is_linear", False) for t in models)
         if n >= self.DEVICE_PREDICT_MIN_ROWS and models and not has_linear:
             return self._predict_session(start, end).raw_scores(X)
@@ -711,14 +748,17 @@ class GBDT:
         X = np.asarray(X, dtype=np.float64)
         n = X.shape[0]
         K = self.num_tree_per_iteration
-        total_iters = len(self.models) // max(K, 1)
-        if num_iteration is None or num_iteration <= 0:
-            num_iteration = total_iters - start_iteration
-        end = min(total_iters, start_iteration + num_iteration)
+        with self._cache_lock:
+            total_iters = len(self.models) // max(K, 1)
+            if num_iteration is None or num_iteration <= 0:
+                num_iteration = total_iters - start_iteration
+            end = min(total_iters, start_iteration + num_iteration)
+            leaf_models = self.models[start_iteration * K:end * K] \
+                if pred_leaf else None
         if pred_leaf:
             out = np.zeros((n, (end - start_iteration) * K), dtype=np.int32)
-            for i in range(start_iteration * K, end * K):
-                out[:, i - start_iteration * K] = self.models[i].predict_leaf_index(X)
+            for i, t in enumerate(leaf_models):
+                out[:, i] = t.predict_leaf_index(X)
             return out
         score = self._raw_scores(X, start_iteration, end)
         score = score + self.init_scores[None, :K]
@@ -734,7 +774,13 @@ class GBDT:
         self.finish_fused("model_to_string")
         cfg = self.config
         K = self.num_tree_per_iteration
-        total_iters = len(self.models) // max(K, 1)
+        # snapshot the model list under the lock: the online trainer
+        # serializes the serving booster from its worker thread (refit
+        # round-trips through the model string) while promotions swap it
+        with self._cache_lock:
+            models = list(self.models)
+            init_scores = self.init_scores.copy()
+        total_iters = len(models) // max(K, 1)
         if num_iteration is None or num_iteration <= 0:
             num_iteration = total_iters
         end = min(total_iters, num_iteration) * K
@@ -745,7 +791,7 @@ class GBDT:
             "objective=%s" % self._objective_string(),
             "num_class=%d" % self.num_class,
             "num_tree_per_iteration=%d" % K,
-            "init_score=%s" % " ".join("%.17g" % v for v in self.init_scores),
+            "init_score=%s" % " ".join("%.17g" % v for v in init_scores),
             "max_feature_idx=%d" % (self.train_set.num_total_features - 1
                                     if self.train_set else -1),
             "feature_names=%s" % " ".join(self.train_set.feature_names
@@ -753,7 +799,7 @@ class GBDT:
             "best_iteration=%d" % self.best_iteration,
             "",
         ]
-        for i, tree in enumerate(self.models[:end]):
+        for i, tree in enumerate(models[:end]):
             lines.append("Tree=%d" % i)
             lines.append(tree.to_text())
             lines.append("")
@@ -939,14 +985,16 @@ class GBDT:
                            iteration: int = -1) -> np.ndarray:
         """(reference: GBDT::FeatureImportance, gbdt.cpp)"""
         self.finish_fused("feature_importance")
+        with self._cache_lock:
+            models = list(self.models)
         nf = self.train_set.num_total_features if self.train_set else (
-            max((t.split_feature.max() for t in self.models
+            max((t.split_feature.max() for t in models
                  if t.num_leaves > 1), default=-1) + 1)
         imp = np.zeros(nf, dtype=np.float64)
         K = self.num_tree_per_iteration
-        end = len(self.models) if iteration <= 0 else min(
-            len(self.models), iteration * K)
-        for t in self.models[:end]:
+        end = len(models) if iteration <= 0 else min(
+            len(models), iteration * K)
+        for t in models[:end]:
             if t.num_leaves <= 1:
                 continue
             for r in range(t.num_internal):

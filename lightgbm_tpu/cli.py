@@ -178,31 +178,78 @@ class Application:
         Log.info("Saved binary dataset to %s.bin", cfg.data)
 
     def serve(self) -> None:
-        """task=serve: stdlib-HTTP JSON prediction endpoint over a loaded
-        model (POST /predict {"rows": [[...]]}; GET /healthz, /telemetry,
-        /metrics). Device-resident pack + bucket-ladder compiled predict
-        + request micro-batching — see lightgbm_tpu/serve/."""
+        """task=serve: stdlib-HTTP JSON prediction endpoint over loaded
+        model(s) (POST /predict[/<id>], /ingest[/<id>]; GET /healthz,
+        /models, /telemetry, /metrics). Device-resident pack +
+        bucket-ladder compiled predict + request micro-batching with
+        admission control — see lightgbm_tpu/serve/. ``serve_models=
+        id=path,...`` hosts extra models next to input_model
+        ("default"); ``online_train=true`` attaches an OnlineTrainer per
+        model (POST /ingest feeds it) — see lightgbm_tpu/online/.
+        SIGTERM drains gracefully: new requests get 503, queued work
+        finishes, telemetry/trace dumps fire, exit 0."""
         cfg = self.config
-        if not cfg.input_model:
-            Log.fatal("task=serve requires input_model")
-        bst = Booster(model_file=cfg.input_model)
+        entries = []
+        if cfg.input_model:
+            entries.append(("default", cfg.input_model))
+        for spec in cfg.serve_models:
+            mid, path = spec.split("=", 1)
+            entries.append((mid.strip(), path.strip()))
+        if not entries:
+            Log.fatal("task=serve requires input_model or serve_models")
+        online_cfg = None
+        if cfg.online_train:
+            online_cfg = dict(
+                mode=cfg.online_mode,
+                trigger_rows=cfg.online_trigger_rows,
+                trigger_interval_s=cfg.online_trigger_interval_s,
+                buffer_rows=cfg.online_buffer_rows,
+                shadow_rows=cfg.online_shadow_rows,
+                promote_threshold=cfg.online_promote_threshold,
+                min_rows=cfg.online_min_rows,
+                continue_rounds=cfg.online_continue_rounds,
+                decay_rate=cfg.refit_decay_rate)
+        from .online import ModelRegistry
         from .serve.http import PredictServer
-        server = PredictServer(
-            bst, host=cfg.serve_host, port=cfg.serve_port,
-            max_batch_rows=cfg.serve_max_batch_rows,
-            max_wait_ms=cfg.serve_max_wait_ms,
-            buckets=cfg.serve_buckets or None,
-            raw_score=cfg.predict_raw_score,
-            warmup=cfg.serve_warmup)
+        registry = ModelRegistry()
+        for mid, path in entries:
+            registry.register(
+                mid, Booster(model_file=path),
+                buckets=cfg.serve_buckets or None,
+                max_batch_rows=cfg.serve_max_batch_rows,
+                max_wait_ms=cfg.serve_max_wait_ms,
+                max_queue_rows=cfg.serve_max_queue_rows,
+                overload=cfg.serve_overload,
+                raw_score=cfg.predict_raw_score,
+                warmup=cfg.serve_warmup,
+                online=dict(online_cfg) if online_cfg else None)
+        server = PredictServer(registry=registry, host=cfg.serve_host,
+                               port=cfg.serve_port)
         host, port = server.address
-        Log.info("Serving %s on http://%s:%d (POST /predict; GET /healthz, "
-                 "/telemetry, /metrics)", cfg.input_model, host, port)
+        Log.info("Serving %s on http://%s:%d (POST /predict, /ingest; GET "
+                 "/healthz, /models, /telemetry, /metrics)",
+                 ", ".join("%s=%s" % e for e in entries), host, port)
         stop_dump = None
         if cfg.dump_telemetry and cfg.telemetry_dump_interval_s > 0:
             # a wedged server still leaves fresh counters on disk
             from .obs_trace import start_periodic_telemetry_dump
             stop_dump = start_periodic_telemetry_dump(
                 cfg.dump_telemetry, cfg.telemetry_dump_interval_s)
+        import signal
+        import threading
+
+        def _on_sigterm(signum, frame):
+            # begin_shutdown calls httpd.shutdown(), which would deadlock
+            # on the thread stuck inside serve_forever (this one) — hop
+            # to a helper thread and let serve_forever return
+            threading.Thread(target=server.begin_shutdown,
+                             name="lgbtpu-serve-drain",
+                             daemon=True).start()
+
+        try:
+            old_term = signal.signal(signal.SIGTERM, _on_sigterm)
+        except ValueError:        # not the main thread (embedded use)
+            old_term = None
         try:
             server.serve_forever()
         except KeyboardInterrupt:
@@ -212,7 +259,12 @@ class Application:
         finally:
             if stop_dump is not None:
                 stop_dump.set()
+            # drains the batchers: requests admitted before the drain
+            # flag flipped still get their answers
             server.close()
+            if old_term is not None:
+                signal.signal(signal.SIGTERM, old_term)
+        Log.info("serve: drained and closed")
 
 
 def main(argv: Optional[List[str]] = None) -> None:

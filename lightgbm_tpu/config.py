@@ -194,6 +194,27 @@ class Config:
     serve_buckets: List[int] = field(default_factory=list)  # [] = default
     #   shape-bucket ladder (serve.session.DEFAULT_BUCKETS)
     serve_warmup: bool = True         # pre-compile the ladder on startup
+    # admission control (serve.batcher.MicroBatcher backpressure):
+    serve_max_queue_rows: int = 0     # cap on queued-but-undispatched rows
+    #   (0 = unbounded). Overflow behavior is serve_overload.
+    serve_overload: str = "shed"      # shed (reject at submit -> HTTP 429)
+    #   | block (submitters wait for queue space; drains preserve order)
+    serve_models: List[str] = field(default_factory=list)  # multi-tenant:
+    #   extra "model_id=path" entries served next to input_model ("default")
+
+    # ---- online training (task=serve + online_train: lightgbm_tpu/online/) ----
+    online_train: bool = False        # run an OnlineTrainer per served model
+    online_mode: str = "refit"        # refit (frozen structure, leaf values
+    #   re-estimated from ingested labels) | continue (init_model training)
+    online_trigger_rows: int = 2048   # retrain once this many rows buffered
+    online_trigger_interval_s: float = 0.0  # also retrain every N s (0 = off)
+    online_buffer_rows: int = 65536   # bounded ingest buffer (drop-oldest)
+    online_shadow_rows: int = 4096    # sliding window of recent labeled
+    #   traffic the candidate is shadow-scored against before promotion
+    online_promote_threshold: float = 1.0  # promote iff candidate_loss <=
+    #   threshold * current_loss on the shadow window (1.0 = "not worse")
+    online_min_rows: int = 64         # never train on fewer buffered rows
+    online_continue_rounds: int = 10  # boosting rounds per continue-mode run
 
     # ---- objective (reference: config.h "Objective Parameters") ----
     num_class: int = 1
@@ -341,6 +362,41 @@ class Config:
                       self.serve_max_wait_ms)
         if any(b < 1 for b in self.serve_buckets):
             Log.fatal("serve_buckets must be positive row counts")
+        if self.serve_max_queue_rows < 0:
+            Log.fatal("serve_max_queue_rows must be >= 0 (0 = unbounded), "
+                      "got %d", self.serve_max_queue_rows)
+        if self.serve_overload not in ("shed", "block"):
+            Log.fatal("serve_overload must be shed or block; got %s",
+                      self.serve_overload)
+        for spec in self.serve_models:
+            if "=" not in spec or not spec.split("=", 1)[0].strip() \
+                    or not spec.split("=", 1)[1].strip():
+                Log.fatal("serve_models entries must be model_id=path, "
+                          "got %r", spec)
+        if self.online_mode not in ("refit", "continue"):
+            Log.fatal("online_mode must be refit or continue; got %s",
+                      self.online_mode)
+        if self.online_trigger_rows < 1:
+            Log.fatal("online_trigger_rows must be >= 1, got %d",
+                      self.online_trigger_rows)
+        if self.online_trigger_interval_s < 0:
+            Log.fatal("online_trigger_interval_s must be >= 0, got %g",
+                      self.online_trigger_interval_s)
+        if self.online_buffer_rows < 1:
+            Log.fatal("online_buffer_rows must be >= 1, got %d",
+                      self.online_buffer_rows)
+        if self.online_shadow_rows < 1:
+            Log.fatal("online_shadow_rows must be >= 1, got %d",
+                      self.online_shadow_rows)
+        if self.online_promote_threshold < 0:
+            Log.fatal("online_promote_threshold must be >= 0, got %g",
+                      self.online_promote_threshold)
+        if self.online_min_rows < 1:
+            Log.fatal("online_min_rows must be >= 1, got %d",
+                      self.online_min_rows)
+        if self.online_continue_rounds < 1:
+            Log.fatal("online_continue_rounds must be >= 1, got %d",
+                      self.online_continue_rounds)
         if self.trace_spans not in ("off", "on", "serve_only"):
             Log.fatal("trace_spans must be off, on or serve_only; got %s",
                       self.trace_spans)

@@ -49,6 +49,11 @@ _THREAD_CTORS = {"threading.Thread", "threading.Timer"}
 
 EXT = "ext:"  # type-tag prefix for external (non-project) constructor types
 
+
+def _fresh_ctor_name(name: str) -> bool:
+    """Factory receivers whose call result is a fresh instance."""
+    return name == "cls" or name.endswith("_cls")
+
 #: bare-name constructors of builtin/stdlib containers and scalars: typing
 #: their results ``ext:`` suppresses the by-name method fallback, so
 #: ``self._warm.add(x)`` on a set never resolves to a project ``add``
@@ -61,7 +66,7 @@ class FuncInfo:
     """One function/method with its lexical position in the project."""
 
     __slots__ = ("node", "file", "qual", "name", "parent", "cls",
-                 "children", "edges", "is_method")
+                 "children", "edges", "confined_edges", "is_method")
 
     def __init__(self, node, file, qual: str, parent: Optional["FuncInfo"],
                  cls: Optional["ClassInfo"]) -> None:
@@ -74,6 +79,13 @@ class FuncInfo:
         self.is_method = cls is not None
         self.children: Dict[str, List["FuncInfo"]] = {}
         self.edges: List["FuncInfo"] = []
+        #: method calls whose receiver is a freshly-constructed local
+        #: (``b = Booster(...); b.refit(...)``): the object is confined
+        #: to the constructing frame, so thread-reachability closures may
+        #: stop at these edges (the subtree runs on the thread but only
+        #: touches thread-local instance state). Full closures (jit
+        #: tracing, host-sync) still follow them.
+        self.confined_edges: List["FuncInfo"] = []
 
     @property
     def self_name(self) -> Optional[str]:
@@ -467,6 +479,28 @@ class ProjectGraph:
                             (id(fn2), kw.arg), set()).update(t)
 
     # ------------------------------------------------------------ call graph
+    def fresh_locals(self, fn: FuncInfo) -> Set[str]:
+        """Local names bound to a freshly-constructed, not-yet-shared
+        object anywhere in ``fn``: direct project-class constructor
+        calls, ``cls(...)``-style factory receivers and ``__new__``.
+        Order-free (a name counts for the whole function body)."""
+        fresh: Set[str] = set()
+        for node in own_walk(fn.node):
+            if not isinstance(node, ast.Assign):
+                continue
+            names = [t.id for t in node.targets if isinstance(t, ast.Name)]
+            if not names or not isinstance(node.value, ast.Call):
+                continue
+            vname = node.value.func
+            if isinstance(vname, ast.Name) \
+                    and (self.resolve_class(fn.file.rel, vname.id)
+                         or _fresh_ctor_name(vname.id)):
+                fresh.update(names)
+            elif isinstance(vname, ast.Attribute) \
+                    and vname.attr == "__new__":
+                fresh.update(names)
+        return fresh
+
     def _build_edges(self) -> None:
         envs = {id(fn): self._local_env(fn) for fn in self.funcs}
         for f in self.files:
@@ -477,6 +511,7 @@ class ProjectGraph:
     def _scan_calls(self, owner: Optional[FuncInfo], f, body,
                     env: Dict[str, Set[str]]) -> None:
         aliases = self.aliases.get(f.rel, {})
+        fresh = self.fresh_locals(owner) if owner is not None else set()
         for node in own_walk(body):
             if not isinstance(node, ast.Call):
                 continue
@@ -495,7 +530,11 @@ class ProjectGraph:
             if isinstance(fn, ast.Name):
                 owner.edges.extend(self.resolve_bare(owner, f.rel, fn.id))
             elif isinstance(fn, ast.Attribute):
-                owner.edges.extend(self._typed_methods(owner, f, env, fn))
+                targets = self._typed_methods(owner, f, env, fn)
+                if isinstance(fn.value, ast.Name) and fn.value.id in fresh:
+                    owner.confined_edges.extend(targets)
+                else:
+                    owner.edges.extend(targets)
 
     # --------------------------------------------------------------- entries
     def jit_entries(self) -> List[FuncInfo]:
@@ -574,10 +613,15 @@ class ProjectGraph:
         return out
 
     # ---------------------------------------------------------- reachability
-    def closure(self, entries: Iterable[FuncInfo]) -> Set[int]:
+    def closure(self, entries: Iterable[FuncInfo],
+                confined: bool = True) -> Set[int]:
         """ids of every function reachable from ``entries`` through call
         edges; nested defs of reachable functions are reachable (they
-        trace/run with their parent)."""
+        trace/run with their parent). ``confined=False`` stops at
+        fresh-receiver call edges (see :attr:`FuncInfo.confined_edges`):
+        thread-reachability closures use it so a worker that builds and
+        drives its own objects does not drag their whole class surface
+        into the shared-state universe."""
         hot: Set[int] = set()
         work: List[FuncInfo] = []
         for e in entries:
@@ -587,6 +631,8 @@ class ProjectGraph:
         while work:
             cur = work.pop()
             nxt: List[FuncInfo] = list(cur.edges)
+            if confined:
+                nxt.extend(cur.confined_edges)
             for group in cur.children.values():
                 nxt.extend(group)
             for fn in nxt:

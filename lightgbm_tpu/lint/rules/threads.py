@@ -29,6 +29,22 @@ Mechanics, on the :mod:`..graph` engine over ``lightgbm_tpu/``:
   attribute name, so ``with g._cache_lock`` in serve/ matches the
   booster's ``with self._cache_lock``. All checked accesses of one field
   must share at least one lock name.
+
+Two confinement escapes keep the closure honest about ownership (PR 8's
+online worker drives ``refit``/``engine.train``, which would otherwise
+drag the whole single-threaded training stack into the shared universe):
+
+- **confined call edges**: thread closures stop at method calls on a
+  freshly-constructed local (``b = Booster(model_str=s); b.refit(...)``)
+  — the receiver is private to the constructing frame, so its class
+  surface is thread-local, not shared. Accesses to genuinely shared
+  objects must therefore go through ``self``/parameters, which DO
+  propagate (see :meth:`~..graph.ProjectGraph.closure`);
+- **owned classes**: ``# graftlint: owned`` on a ``class`` line declares
+  the ownership-transfer idiom — instances are built and mutated by one
+  thread, frozen, then published via an explicitly-locked handoff
+  (``Tree`` under ``GBDT.adopt``). Fields of owned classes are exempt;
+  the lock rule polices the handoff object instead.
 """
 from __future__ import annotations
 
@@ -53,6 +69,10 @@ _MUTATORS = {"append", "extend", "insert", "add", "discard", "remove",
 
 _GUARDED_RE = re.compile(r"#\s*graftlint:\s*guarded-by=([A-Za-z0-9_.\-]+)")
 
+#: class-line annotation for ownership-transfer types (single-threaded
+#: build, locked publish): their instance fields skip lock-discipline
+_OWNED_RE = re.compile(r"#\s*graftlint:\s*owned\b")
+
 _READ, _WRITE, _MUTATE = "read", "write", "mutate"
 
 
@@ -63,10 +83,6 @@ class _Access:
         self.fn = fn
         self.node = node
         self.kind = kind
-
-
-def _fresh_ctor_name(name: str) -> bool:
-    return name == "cls" or name.endswith("_cls")
 
 
 @register
@@ -121,18 +137,20 @@ class LockDisciplineRule(Rule):
         in_thread: Set[int] = set()
         target_ids = {id(fn) for fn, _ in thread_roots}
         for fn, label in thread_roots:
-            cl = g.closure([fn])
+            cl = g.closure([fn], confined=False)
             closures.setdefault(label, set()).update(cl)
             in_thread |= cl
         main_closure = g.closure(
             fn for fn in g.funcs if id(fn) not in in_thread)
 
+        owned = {ci.qual for ci in g.classes
+                 if _OWNED_RE.search(ci.file.line_text(ci.node.lineno))}
         lock_names = self._lock_names(g)
         init_only = self._init_only(g, target_ids)
         accesses, blessed = self._collect(g, lock_names, init_only)
 
         for (owner, attr), accs in sorted(accesses.items()):
-            if (owner, attr) in blessed:
+            if (owner, attr) in blessed or owner in owned:
                 continue
             roots: Set[str] = set()
             for a in accs:
@@ -193,7 +211,7 @@ class LockDisciplineRule(Rule):
         ``__init__``: writes there happen before the object is shared."""
         callers: Dict[int, List[FuncInfo]] = {}
         for fn in g.funcs:
-            for tgt in fn.edges:
+            for tgt in fn.edges + fn.confined_edges:
                 callers.setdefault(id(tgt), []).append(fn)
         init: Set[int] = {id(fn) for fn in g.funcs
                           if fn.is_method and fn.name == "__init__"}
@@ -238,7 +256,7 @@ class LockDisciplineRule(Rule):
             f = fn.file
             env = g._local_env(fn)
             in_init = id(fn) in init_only
-            fresh: Set[str] = set()
+            fresh = g.fresh_locals(fn)
             alias: Dict[str, Set[Tuple[str, str]]] = {}
 
             def recv_keys(expr: ast.AST, attr: str) -> Set[Tuple[str, str]]:
@@ -274,7 +292,8 @@ class LockDisciplineRule(Rule):
                 return isinstance(expr, ast.Name) \
                     and expr.id == fn.self_name
 
-            # pre-pass: fresh locals and one-level aliases (order-free)
+            # pre-pass: one-level aliases (order-free; fresh locals come
+            # from the engine — same set the confined-edge cut uses)
             for node in own_walk(fn.node):
                 if not isinstance(node, ast.Assign):
                     continue
@@ -285,15 +304,7 @@ class LockDisciplineRule(Rule):
                 v = node.value
                 if isinstance(v, ast.Call):
                     vname = v.func
-                    # constructor / cls(...) / __new__ => fresh object
                     if isinstance(vname, ast.Name) \
-                            and (g.resolve_class(f.rel, vname.id)
-                                 or _fresh_ctor_name(vname.id)):
-                        fresh.update(names)
-                    elif isinstance(vname, ast.Attribute) \
-                            and vname.attr == "__new__":
-                        fresh.update(names)
-                    elif isinstance(vname, ast.Name) \
                             and vname.id == "getattr" \
                             and len(v.args) >= 2 \
                             and isinstance(v.args[1], ast.Constant) \

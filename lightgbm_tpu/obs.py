@@ -439,6 +439,18 @@ class Telemetry:
         finally:
             self.add_time(name, time.perf_counter() - t0)
 
+    @contextlib.contextmanager
+    def timed_observe(self, name: str) -> Iterator[None]:
+        """Observe the block's wall time in MILLISECONDS into histogram
+        ``name`` — for events whose distribution matters (online train
+        cycles, promotion swaps), where ``timed`` would collapse them
+        into a single running total."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.observe(name, (time.perf_counter() - t0) * 1e3)
+
     def record(self, name: str, dedupe_key=None, **payload) -> None:
         """Append a structured event to the ``name`` list. With
         ``dedupe_key``, an event carrying the same key is appended at most

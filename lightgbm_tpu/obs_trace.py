@@ -179,8 +179,12 @@ class SpanTracer(object):
         telemetry.observe(_SPAN_HIST_PREFIX + sp.name, sp.dur * 1e3)
 
     def span(self, name, domain="train", trace_id=None, **args):
-        """Context-manager span; shared no-op when the domain is off."""
-        on = self.serve_on if domain == "serve" else self.train_on
+        """Context-manager span; shared no-op when the domain is off.
+        The ``online`` domain (continual-refit trainer: train cycles,
+        shadow scoring, promotion swaps) records whenever the serve chain
+        does — promotions are part of the serving story, and serve_only
+        deployments must still see them."""
+        on = self.serve_on if domain in ("serve", "online") else self.train_on
         if not on:
             return NULL_SPAN
         return _SpanCtx(self, self.begin(name, trace_id, args or None))

@@ -1,0 +1,29 @@
+"""Online continual-learning subsystem: train-and-serve in one process.
+
+Three pieces close the loop over the existing serve/ stack:
+
+- :class:`TrafficBuffer` — bounded labeled-traffic buffer + sliding
+  shadow window of recent live rows;
+- :class:`OnlineTrainer` — background worker that refits (or continues
+  training) off the serving thread, shadow-scores the candidate against
+  recent traffic and atomically promotes it into the serving booster
+  (single version-token bump under ``_cache_lock``; rollback retained);
+- :class:`ModelRegistry` — multi-tenant model id -> per-model
+  PredictSession/MicroBatcher map behind ``/predict/<model_id>``.
+
+    bst = lgb.train(params, train_set)
+    ot = lgb.online.OnlineTrainer(bst, trigger_rows=4096)
+    ot.ingest(X_live, y_live)        # from serving traffic
+    # ... background worker refits, gates, promotes; serving sessions
+    # over bst pick the promoted model up on their next dispatch
+
+The CLI wires this into ``task=serve`` via ``online_train=true`` (POST
+``/ingest`` feeds the buffer) and ``serve_models=id=path,...`` for
+multi-tenant serving. See README "Online training".
+"""
+from .buffer import TrafficBuffer
+from .registry import ModelRegistry, RegistryEntry
+from .trainer import OnlineTrainer
+
+__all__ = ["TrafficBuffer", "OnlineTrainer", "ModelRegistry",
+           "RegistryEntry"]

@@ -1,0 +1,391 @@
+"""Continual refit with a shadow-scoring promotion gate.
+
+:class:`OnlineTrainer` closes the loop the ROADMAP calls
+"train-and-serve in one process": labeled traffic is ingested into a
+bounded :class:`~lightgbm_tpu.online.buffer.TrafficBuffer`, a background
+worker trains a CANDIDATE model off the serving thread — ``refit`` (leaf
+values re-estimated on the frozen structure, the reference
+GBDT::RefitTree contract) or ``continue`` (more boosting rounds via
+``init_model``) — and the candidate is only promoted into the serving
+booster if it shadow-scores at least as well as the incumbent on a
+sliding window of recent live traffic.
+
+Promotion is atomic: :meth:`GBDT.adopt` swaps the model list under the
+booster's ``_cache_lock`` with a SINGLE version-token bump, so every
+concurrent ``PredictSession`` snapshot sees the old ensemble or the new
+one whole — never a half-committed pack. The displaced model is retained
+as a rollback token (:meth:`OnlineTrainer.rollback`).
+
+Telemetry: ``online/ingested_rows``, ``online/train_runs``,
+``online/promotions``, ``online/rejections``, ``online/train_errors``
+counters; ``online/train_ms``, ``online/shadow_ms``,
+``online/promote_swap_ms`` histograms; ``online/train_cycle`` /
+``online/shadow_score`` / ``online/promote`` spans in the flight
+recorder (domain ``online`` records whenever the serve chain does).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from .. import obs
+from ..obs import telemetry
+from ..obs_trace import tracer
+from ..utils.log import Log, LightGBMError
+from .buffer import TrafficBuffer
+
+MODES = ("refit", "continue")
+
+#: floor for probabilities inside log-losses (reference binary_objective
+#: uses a sigmoid that never saturates to exactly 0/1; host-side clipping
+#: keeps a degenerate candidate finite instead of -inf)
+_EPS = 1e-15
+
+
+class _CandidateBuilder:
+    """Thread-confined candidate factory for one train cycle.
+
+    Holds a serialized snapshot of the serving model plus plain arrays;
+    every object it builds (base booster, candidate, incumbent copy,
+    datasets) is private to the worker's cycle — the cycle's only
+    cross-thread surfaces are the trainer's lock-guarded snapshot cache
+    and the guarded ``adopt`` that publishes the winner.
+    graftlint models exactly this: calls on a freshly-constructed
+    receiver do not propagate thread-reachability."""
+
+    def __init__(self, mode: str, model_str: str,
+                 train_params: Dict[str, Any], continue_rounds: int,
+                 decay_rate: Optional[float]) -> None:
+        self._mode = mode
+        self._src = model_str
+        self._params = dict(train_params)
+        self._rounds = int(continue_rounds)
+        self._decay = decay_rate
+
+    def build(self, X: np.ndarray, y: np.ndarray):
+        """Train the candidate: leaf re-estimation on the frozen
+        structure (``refit``, the reference GBDT::RefitTree contract) or
+        more boosting rounds from the snapshot (``continue``)."""
+        from ..basic import Booster, Dataset
+        base = Booster(model_str=self._src)
+        if self._mode == "refit":
+            return base.refit(X, y, decay_rate=self._decay)
+        from ..engine import train as _train
+        return _train(self._params, Dataset(X, label=y),
+                      num_boost_round=self._rounds, init_model=base)
+
+    def serialize(self, candidate) -> str:
+        """Candidate's model string (the next cycle's snapshot when this
+        one wins promotion). Runs here, not in the trainer, so the
+        serialization stays on the worker's private objects."""
+        return candidate.model_to_string()
+
+    def score_pair(self, candidate, X: np.ndarray,
+                   y: np.ndarray) -> tuple:
+        """(incumbent_loss, candidate_loss) on the shadow window. The
+        incumbent is scored as a private copy of the snapshot so shadow
+        scoring never contends with live serving dispatches."""
+        from ..basic import Booster
+        incumbent = Booster(model_str=self._src)
+        return self._loss(incumbent, X, y), self._loss(candidate, X, y)
+
+    def _loss(self, model, X: np.ndarray, y: np.ndarray) -> float:
+        """Objective-matched mean loss: logloss for binary, multi-logloss
+        for multiclass, MSE otherwise (predictions come back transformed,
+        so probabilities are directly comparable)."""
+        pred = np.asarray(model.predict(X), np.float64)
+        obj = getattr(model.inner.objective, "name", "") \
+            if model.inner.objective is not None else ""
+        n = len(y)
+        if obj == "binary":
+            p = np.clip(pred.ravel(), _EPS, 1.0 - _EPS)
+            return float(-np.mean(y * np.log(p) + (1 - y) * np.log(1 - p)))
+        if obj.startswith("multiclass"):
+            p = pred.reshape(n, -1)
+            picked = p[np.arange(n), y.astype(np.int64)]
+            return float(-np.mean(np.log(np.clip(picked, _EPS, 1.0))))
+        return float(np.mean((pred.ravel() - y) ** 2))
+
+
+class OnlineTrainer:
+    """Background continual-training loop over one serving booster.
+
+    ``booster`` is the live ``lgb.Booster`` the serving sessions hold;
+    promotions mutate it in place (atomically) so every
+    ``PredictSession``/``MicroBatcher`` over it picks the new model up on
+    its next dispatch without reconnecting anything.
+
+    With ``start=True`` (default) a named daemon worker thread watches
+    the buffer and trains whenever ``trigger_rows`` rows accumulated (or
+    ``trigger_interval_s`` elapsed with at least ``min_rows`` buffered).
+    Tests drive the same cycle synchronously via :meth:`run_once` with
+    ``start=False``.
+    """
+
+    def __init__(self, booster, *, mode: str = "refit",
+                 trigger_rows: int = 2048,
+                 trigger_interval_s: float = 0.0,
+                 buffer_rows: int = 65536, shadow_rows: int = 4096,
+                 promote_threshold: float = 1.0, min_rows: int = 64,
+                 continue_rounds: int = 10,
+                 continue_params: Optional[Dict[str, Any]] = None,
+                 decay_rate: Optional[float] = None,
+                 candidate_factory=None,
+                 start: bool = True) -> None:
+        if mode not in MODES:
+            raise LightGBMError("online mode must be one of %s, got %r"
+                                % ("|".join(MODES), mode))
+        if not hasattr(booster, "refit") or not hasattr(booster, "inner"):
+            raise LightGBMError(
+                "OnlineTrainer needs a lightgbm_tpu.Booster (refit and "
+                "adopt live on the Booster API)")
+        if trigger_rows < 1:
+            raise LightGBMError("online trigger_rows must be >= 1")
+        if promote_threshold < 0:
+            raise LightGBMError("online promote_threshold must be >= 0")
+        self._booster = booster
+        self._mode = mode
+        self._trigger_rows = int(trigger_rows)
+        self._interval = float(trigger_interval_s)
+        self._min_rows = max(1, int(min_rows))
+        self._threshold = float(promote_threshold)
+        self._continue_rounds = int(continue_rounds)
+        self._decay = decay_rate
+        # test/extension hook: a callable (X, y) -> Booster replaces the
+        # default candidate build (degraded-candidate gate tests)
+        self._candidate_factory = candidate_factory
+        # continue-mode params frozen here (main thread) so the worker
+        # never reads live config off the shared booster
+        cfg = getattr(booster, "config", None)
+        params: Dict[str, Any] = {"verbosity": -1}
+        if cfg is not None:
+            params.update(objective=cfg.objective, num_class=cfg.num_class,
+                          learning_rate=cfg.learning_rate,
+                          num_leaves=cfg.num_leaves, max_bin=cfg.max_bin)
+        params.update(continue_params or {})
+        self._train_params = params
+        # serving-model snapshot cache: serialized HERE (main thread,
+        # before the worker exists) and thereafter only updated at
+        # promotion/rollback from strings the worker computed on its own
+        # private candidate. The worker never serializes the live
+        # booster, so its only shared-model calls are the lock-guarded
+        # adopt/restore swaps. Contract: the trainer is the sole mutator
+        # of the served model after start — training the live booster
+        # externally desyncs this snapshot.
+        self._model_str = booster.model_to_string()
+        self.buffer = TrafficBuffer(buffer_rows, shadow_rows)
+        # Condition doubles as the state lock (counters, last-result
+        # strings, the rollback token) and the worker's wakeup: ingest
+        # notifies when a trigger is reached, close notifies to stop.
+        self._lock = threading.Condition()
+        self._stopped = False
+        self._trains = 0
+        self._promotions = 0
+        self._rejections = 0
+        self._errors = 0
+        self._last_result = "idle"
+        self._last_error = ""
+        self._last_losses: Optional[Dict[str, float]] = None
+        self._rollback: Optional[tuple] = None
+        self._last_train_t = obs.monotonic()
+        # pre-touch the promotion counters so a freshly-started online
+        # server exposes the whole family on /metrics before the first
+        # train cycle (dashboards key on the series existing)
+        telemetry.count("online/promotions", 0)
+        telemetry.count("online/rejections", 0)
+        telemetry.count("online/train_runs", 0)
+        self._thread: Optional[threading.Thread] = None
+        if start:
+            self._thread = threading.Thread(
+                target=self._worker, name="lgbtpu-online-trainer",
+                daemon=True)
+            self._thread.start()
+
+    # --------------------------------------------------------------- ingest
+    def ingest(self, X, y) -> int:
+        """Add labeled rows (features, labels) to the training buffer and
+        shadow window; returns the buffered row count. Called from HTTP
+        handler threads (POST /ingest) or embedding code; never blocks on
+        training."""
+        y_arr = np.asarray(y, np.float64).ravel()
+        buffered = self.buffer.push(X, y_arr)
+        telemetry.count("online/ingested_rows", int(y_arr.size))
+        telemetry.gauge("online/buffered_rows", buffered)
+        if buffered >= self._trigger_rows:
+            with self._lock:
+                self._lock.notify_all()
+        return buffered
+
+    # --------------------------------------------------------------- worker
+    def _worker(self) -> None:
+        # poll granularity: the interval trigger when set, else a coarse
+        # tick — row triggers arrive via notify so the tick only bounds
+        # shutdown latency
+        poll = self._interval if self._interval > 0 else 0.5
+        while True:
+            with self._lock:
+                if self._stopped:
+                    return
+                self._lock.wait(timeout=poll)
+                if self._stopped:
+                    return
+            if self._should_train():
+                try:
+                    self.run_once()
+                except BaseException as exc:
+                    # a failed train cycle must never take serving down:
+                    # record, count, keep looping
+                    telemetry.count("online/train_errors")
+                    with self._lock:
+                        self._errors += 1
+                        self._last_error = "%s: %s" % (type(exc).__name__,
+                                                       exc)
+                    Log.warning("online: train cycle failed: %s: %s",
+                                type(exc).__name__, exc)
+
+    def _should_train(self) -> bool:
+        rows = self.buffer.rows
+        if rows >= self._trigger_rows:
+            return True
+        if self._interval > 0 and rows >= self._min_rows:
+            with self._lock:
+                last = self._last_train_t
+            return obs.monotonic() - last >= self._interval
+        return False
+
+    # ---------------------------------------------------------------- cycle
+    def run_once(self) -> str:
+        """One synchronous train cycle: drain the buffer, build a
+        candidate, shadow-score it, promote or reject. Returns
+        ``"promoted"``, ``"rejected"`` or ``"skipped"`` (not enough
+        data). Tests call this directly with ``start=False``."""
+        with self._lock:
+            self._last_train_t = obs.monotonic()
+        data = self.buffer.take_training()
+        if data is None or len(data[1]) < self._min_rows:
+            if data is not None:
+                # not enough signal yet — put it back for the next cycle
+                self.buffer.push(data[0], data[1])
+            self._finish("skipped", None)
+            return "skipped"
+        X, y = data
+        with tracer.span("online/train_cycle", domain="online",
+                         rows=int(len(y)), mode=self._mode):
+            telemetry.count("online/train_runs")
+            telemetry.count("online/trained_rows", int(len(y)))
+            with self._lock:
+                self._trains += 1
+            # snapshot of the serving model, maintained across
+            # promotions/rollbacks — everything downstream is private to
+            # the builder until the guarded adopt publishes the winner
+            with self._lock:
+                src = self._model_str
+            builder = _CandidateBuilder(self._mode, src,
+                                        self._train_params,
+                                        self._continue_rounds, self._decay)
+            with telemetry.timed_observe("online/train_ms"), \
+                    tracer.span("online/train", domain="online"):
+                candidate = (self._candidate_factory(X, y)
+                             if self._candidate_factory is not None
+                             else builder.build(X, y))
+            accept, losses = False, None
+            shadow = self.buffer.shadow()
+            if shadow is not None:  # no traffic to judge on => reject
+                Xs, ys = shadow
+                with telemetry.timed_observe("online/shadow_ms"), \
+                        tracer.span("online/shadow_score", domain="online",
+                                    rows=int(len(ys))):
+                    cur, cand = builder.score_pair(candidate, Xs, ys)
+                losses = {"current": float(cur), "candidate": float(cand),
+                          "threshold": self._threshold,
+                          "rows": int(len(ys))}
+                accept = bool(np.isfinite(cand)
+                              and cand <= self._threshold * cur + 1e-12)
+            if accept:
+                self._promote(candidate, builder.serialize(candidate), src)
+                self._finish("promoted", losses)
+                return "promoted"
+            telemetry.count("online/rejections")
+            with self._lock:
+                self._rejections += 1
+            self._finish("rejected", losses)
+            return "rejected"
+
+    # ------------------------------------------------------------ promotion
+    def _promote(self, candidate, cand_str: str, prev_str: str) -> None:
+        with telemetry.timed_observe("online/promote_swap_ms"), \
+                tracer.span("online/promote", domain="online"):
+            token = self._booster.adopt(candidate)
+        with self._lock:
+            # rollback token carries the displaced model's string so the
+            # snapshot cache rewinds with the swap
+            self._rollback = (token, prev_str)
+            self._model_str = cand_str
+            self._promotions += 1
+        telemetry.count("online/promotions")
+        telemetry.gauge("online/model_version",
+                        self._booster.inner.model_version)
+
+    def rollback(self) -> bool:
+        """Restore the model displaced by the last promotion (single
+        atomic swap, like the promotion itself). Returns False when
+        there is nothing to roll back to."""
+        with self._lock:
+            tok = self._rollback
+            self._rollback = None
+        if tok is None:
+            return False
+        snapshot, prev_str = tok
+        self._booster.restore(snapshot)
+        with self._lock:
+            self._model_str = prev_str
+        telemetry.count("online/rollbacks")
+        return True
+
+    def _finish(self, result: str, losses) -> None:
+        with self._lock:
+            self._last_result = result
+            if losses is not None:
+                self._last_losses = losses
+
+    # ----------------------------------------------------------------- state
+    def state(self) -> Dict[str, Any]:
+        """JSON-serializable trainer state (surfaced on /healthz)."""
+        with self._lock:
+            st = {
+                "running": self._thread.is_alive()
+                if self._thread is not None else False,
+                "mode": self._mode,
+                "trigger_rows": self._trigger_rows,
+                "trains": self._trains,
+                "promotions": self._promotions,
+                "rejections": self._rejections,
+                "errors": self._errors,
+                "last_result": self._last_result,
+                "last_error": self._last_error,
+                "last_losses": self._last_losses,
+                "can_rollback": self._rollback is not None,
+            }
+        st["buffered_rows"] = self.buffer.rows
+        st["shadow_rows"] = self.buffer.shadow_rows
+        st["dropped_rows"] = self.buffer.dropped_rows
+        st["total_ingested_rows"] = self.buffer.total_rows
+        st["model_version"] = self._booster.inner.model_version
+        return st
+
+    # -------------------------------------------------------------- shutdown
+    def close(self, timeout: Optional[float] = None) -> None:
+        """Stop the worker (the in-flight cycle finishes). Idempotent."""
+        with self._lock:
+            self._stopped = True
+            self._lock.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    def __enter__(self) -> "OnlineTrainer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -12,6 +12,13 @@ Results come back through ``concurrent.futures.Future``; a worker
 exception fails every future of its batch (callers see the real error,
 the worker keeps serving). ``close()`` drains and fails whatever is still
 queued, then joins the thread.
+
+Admission control: ``max_queue_rows`` bounds how many rows may sit queued
+but undispatched. Overflow behavior is the ``overload`` policy — ``shed``
+raises :class:`QueueFullError` at submit (the HTTP layer maps it to 429,
+so overload degrades into fast rejections instead of unbounded latency),
+``block`` parks submitters until the worker drains space (per-caller
+backpressure; an upstream of bounded concurrency self-throttles).
 """
 from __future__ import annotations
 
@@ -27,6 +34,13 @@ from ..obs import telemetry
 from ..obs_trace import tracer
 
 _STOP = object()
+
+OVERLOAD_POLICIES = ("shed", "block")
+
+
+class QueueFullError(RuntimeError):
+    """submit() rejected because the queue holds ``max_queue_rows`` under
+    the ``shed`` overload policy (HTTP maps this to 429)."""
 
 
 class _Request:
@@ -49,21 +63,33 @@ class MicroBatcher:
 
     def __init__(self, session, *, max_batch_rows: int = 8192,
                  max_wait_ms: float = 2.0, raw_score: bool = False,
-                 latency_window: int = 2048) -> None:
+                 latency_window: int = 2048, max_queue_rows: int = 0,
+                 overload: str = "shed") -> None:
         if max_batch_rows < 1:
             raise ValueError("max_batch_rows must be >= 1")
         if max_wait_ms < 0:
             raise ValueError("max_wait_ms must be >= 0")
+        if max_queue_rows < 0:
+            raise ValueError("max_queue_rows must be >= 0 (0 = unbounded)")
+        if overload not in OVERLOAD_POLICIES:
+            raise ValueError("overload must be one of %s, got %r"
+                             % ("|".join(OVERLOAD_POLICIES), overload))
         self._session = session
         self._max_rows = int(max_batch_rows)
         self._max_wait = float(max_wait_ms) / 1000.0
         self._raw = bool(raw_score)
+        self._max_queue_rows = int(max_queue_rows)
+        self._shed = overload == "shed"
         self._q: "queue.SimpleQueue" = queue.SimpleQueue()
-        # one lock, two jobs: (a) makes submit's closed-check atomic with
-        # the enqueue so no request can slip in behind close()'s _STOP and
-        # hang its Future forever; (b) guards the latency histogram, which
-        # the worker feeds while callers read latency_stats()
-        self._lock = threading.Lock()
+        # one lock, three jobs: (a) makes submit's closed-check atomic
+        # with the enqueue so no request can slip in behind close()'s
+        # _STOP and hang its Future forever; (b) guards the latency
+        # histogram, which the worker feeds while callers read
+        # latency_stats(); (c) guards the queued-row accounting behind
+        # admission control. It is a Condition so block-policy submitters
+        # can park on it until the worker drains space.
+        self._lock = threading.Condition()
+        self._queued_rows = 0
         # log-bucketed histogram over submit->delivery latency in ms:
         # bounded memory at any request count, exact bucket counts for
         # /metrics; also mirrored into the global registry under
@@ -86,7 +112,14 @@ class MicroBatcher:
         is closed — atomically with close(), so a submit either lands
         before the worker's stop marker (and gets an answer or a
         deterministic 'closed' failure from the drain) or raises here; it
-        never hangs."""
+        never hangs.
+
+        With ``max_queue_rows`` set, an over-limit submit raises
+        :class:`QueueFullError` (shed policy) or waits for queue space
+        (block policy). A request alone bigger than the whole bound is
+        admitted when the queue is empty — it can never fit better than
+        that, so rejecting it forever would deadlock block-policy
+        callers."""
         X = np.asarray(X, np.float64)
         if X.ndim == 1:
             X = X[None, :]
@@ -96,11 +129,41 @@ class MicroBatcher:
         with self._lock:
             if self._closed:
                 raise RuntimeError("MicroBatcher is closed")
+            if self._max_queue_rows > 0:
+                while self._queued_rows > 0 and \
+                        self._queued_rows + req.rows > self._max_queue_rows:
+                    if self._shed:
+                        telemetry.count("serve/shed")
+                        telemetry.count("serve/shed_rows", req.rows)
+                        raise QueueFullError(
+                            "queue holds %d rows; admitting %d more would "
+                            "exceed max_queue_rows=%d"
+                            % (self._queued_rows, req.rows,
+                               self._max_queue_rows))
+                    self._lock.wait()
+                    if self._closed:
+                        raise RuntimeError("MicroBatcher is closed")
+            self._queued_rows += req.rows
+            depth = self._queued_rows
             self._q.put(req)
         telemetry.count("serve/requests")
         telemetry.count("serve/rows", req.rows)
         telemetry.gauge("serve/queue_depth", self._q.qsize())
+        telemetry.observe("serve/queue_depth_rows", depth)
         return req.future
+
+    def queue_rows(self) -> int:
+        """Rows submitted but not yet picked up by the worker (the
+        admission-control quantity; /healthz queue depth)."""
+        with self._lock:
+            return self._queued_rows
+
+    def _dequeued(self, req) -> None:
+        # a dequeued request frees its queue-space reservation; wake any
+        # block-policy submitters parked in submit()
+        with self._lock:
+            self._queued_rows -= req.rows
+            self._lock.notify_all()
 
     # ---------------------------------------------------------------- worker
     def _worker(self) -> None:
@@ -109,6 +172,7 @@ class MicroBatcher:
             req = self._q.get()
             if req is _STOP:
                 break
+            self._dequeued(req)
             batch = [req]
             rows = req.rows
             t_first = obs.monotonic()    # lead request leaves the queue
@@ -131,6 +195,7 @@ class MicroBatcher:
                 if nxt is _STOP:
                     stop = True
                     break
+                self._dequeued(nxt)
                 batch.append(nxt)
                 rows += nxt.rows
             telemetry.gauge("serve/queue_depth", self._q.qsize())
@@ -217,6 +282,7 @@ class MicroBatcher:
                 return
             if r is _STOP:
                 continue
+            self._dequeued(r)
             if not r.future.done():
                 r.future.set_exception(RuntimeError("MicroBatcher closed"))
 
@@ -224,12 +290,15 @@ class MicroBatcher:
         """Stop accepting requests, finish the in-flight batch, fail any
         still-queued futures, join the worker. Idempotent. The flag flip
         and the stop marker go in under the submit lock, so every request
-        that beat the flip sits ahead of _STOP and gets drained."""
+        that beat the flip sits ahead of _STOP and gets drained;
+        block-policy submitters parked for queue space are woken and
+        raise instead of hanging on a dead worker."""
         with self._lock:
             if self._closed:
                 return
             self._closed = True
             self._q.put(_STOP)
+            self._lock.notify_all()
         self._thread.join(timeout)
 
     def __enter__(self) -> "MicroBatcher":

@@ -1,12 +1,27 @@
 """Stdlib-HTTP JSON prediction endpoint (``task=serve`` in the CLI).
 
-    POST /predict   {"rows": [[f0, f1, ...], ...]}
-                    -> {"predictions": [...], "rows": n}
-    GET  /healthz   liveness + model/bucket info
-    GET  /telemetry full obs.Telemetry snapshot (serve/* counters, jit
-                    compile counts, latency gauges + histograms)
-    GET  /metrics   the registry in Prometheus text exposition format
-                    (latency/batch-size histogram buckets included)
+    POST /predict              {"rows": [[f0, f1, ...], ...]}
+                               -> {"predictions": [...], "rows": n,
+                                   "model_version": v}
+    POST /predict/<model_id>   same, routed to one registry entry
+                               (also: {"model": "<id>"} in the body)
+    POST /ingest[/<model_id>]  {"rows": [[...]], "labels": [...]}
+                               feed labeled traffic to the model's
+                               OnlineTrainer (409 if online training is
+                               off for that model)
+    GET  /healthz              liveness + per-model version/queue/online
+                               state, registry size, uptime
+    GET  /models               registered model ids
+    GET  /telemetry            full obs.Telemetry snapshot
+    GET  /metrics              Prometheus text exposition format
+
+Multi-tenant: the server fronts a
+:class:`~lightgbm_tpu.online.registry.ModelRegistry`; the single-model
+constructor registers its booster under id ``"default"``. Admission
+control: an over-limit submit under the shed policy returns **429**;
+during graceful shutdown (:meth:`PredictServer.begin_shutdown`, wired to
+SIGTERM by the CLI) every new request gets **503** while already-queued
+work drains to completion.
 
 With span tracing on (``trace_spans=on|serve_only``), each POST opens a
 ``serve/http_request`` span carrying a fresh trace id that the batcher
@@ -20,6 +35,8 @@ device dispatch. No dependencies beyond the standard library.
 from __future__ import annotations
 
 import json
+import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Sequence
 
@@ -28,32 +45,60 @@ import numpy as np
 from .. import obs
 from ..obs import telemetry
 from ..obs_trace import tracer
-from ..utils.log import Log
-from .batcher import MicroBatcher
-from .session import PredictSession
+from ..utils.log import LightGBMError, Log
+from .batcher import QueueFullError
 
 
 class PredictServer:
-    """PredictSession + MicroBatcher behind a stdlib HTTP server.
+    """ModelRegistry (PredictSessions + MicroBatchers) behind a stdlib
+    HTTP server.
+
+    Single-model: ``PredictServer(booster, ...)`` (registered as
+    ``"default"``; ``server.session``/``server.batcher`` keep pointing at
+    it). Multi-tenant: build a
+    :class:`~lightgbm_tpu.online.registry.ModelRegistry` yourself and
+    pass ``registry=``. ``online`` (an OnlineTrainer or its kwargs dict)
+    attaches continual training to the single-model constructor's entry.
 
     ``port=0`` binds an ephemeral port (tests); read it back from
     ``server.address``. ``serve_forever()`` blocks; call ``close()`` (any
-    thread) to stop the server and the batcher worker.
+    thread) to stop the server and the batcher workers, or
+    ``begin_shutdown()`` for the draining path (refuse new work with 503,
+    let queued requests finish, then unblock serve_forever).
     """
 
-    def __init__(self, model, *, host: str = "127.0.0.1", port: int = 8080,
+    def __init__(self, model=None, *, registry=None,
+                 host: str = "127.0.0.1", port: int = 8080,
                  max_batch_rows: int = 8192, max_wait_ms: float = 2.0,
                  buckets: Optional[Sequence[int]] = None,
                  raw_score: bool = False, warmup: bool = True,
-                 request_timeout_s: float = 30.0) -> None:
-        self.session = PredictSession(model, buckets=buckets)
-        if warmup:
-            self.session.warmup()
-        self.batcher = MicroBatcher(self.session,
-                                    max_batch_rows=max_batch_rows,
-                                    max_wait_ms=max_wait_ms,
-                                    raw_score=raw_score)
+                 request_timeout_s: float = 30.0,
+                 max_queue_rows: int = 0, overload: str = "shed",
+                 online=None) -> None:
+        from ..online.registry import ModelRegistry
+
+        if registry is None:
+            if model is None:
+                raise LightGBMError(
+                    "PredictServer needs a model or a registry")
+            registry = ModelRegistry()
+            registry.register("default", model, buckets=buckets,
+                              max_batch_rows=max_batch_rows,
+                              max_wait_ms=max_wait_ms,
+                              max_queue_rows=max_queue_rows,
+                              overload=overload, raw_score=raw_score,
+                              warmup=warmup, online=online)
+        elif model is not None or online is not None:
+            raise LightGBMError(
+                "pass either model/online or a pre-built registry, "
+                "not both")
+        self.registry = registry
         self.request_timeout_s = float(request_timeout_s)
+        self._started_at = obs.monotonic()
+        # guards the draining flag: flipped by begin_shutdown (signal
+        # helper thread) and read on every handler thread
+        self._lock = threading.Lock()
+        self._draining = False
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -70,12 +115,9 @@ class PredictServer:
 
             def do_GET(self):
                 if self.path == "/healthz":
-                    self._json(200, {
-                        "status": "ok",
-                        "model_version": server.session._gbdt.model_version,
-                        "buckets": list(server.session.buckets),
-                        "requests": telemetry.counter("serve/requests"),
-                    })
+                    self._json(200, server.healthz())
+                elif self.path == "/models":
+                    self._json(200, {"models": server.registry.ids()})
                 elif self.path == "/telemetry":
                     self._json(200, telemetry.snapshot())
                 elif self.path == "/metrics":
@@ -90,34 +132,123 @@ class PredictServer:
                     self._json(404, {"error": "unknown path %s" % self.path})
 
             def do_POST(self):
-                if self.path != "/predict":
-                    self._json(404, {"error": "unknown path %s" % self.path})
-                    return
                 try:
                     length = int(self.headers.get("Content-Length") or 0)
                     payload = json.loads(self.rfile.read(length) or b"{}")
-                    rows = payload["rows"]
-                    X = np.asarray(rows, np.float64)
+                except Exception as exc:
+                    self._json(400, {"error": "bad request body: %s" % exc})
+                    return
+                if server.draining():
+                    telemetry.count("serve/drain_rejected")
+                    self._json(503, {"error": "server is draining"})
+                    return
+                seg = [s for s in self.path.split("/") if s]
+                route = seg[0] if seg else ""
+                if route not in ("predict", "ingest") or len(seg) > 2:
+                    self._json(404, {"error": "unknown path %s" % self.path})
+                    return
+                model_id = seg[1] if len(seg) == 2 \
+                    else payload.get("model")
+                try:
+                    entry = server.registry.get(model_id)
+                except KeyError as exc:
+                    self._json(404, {"error": str(exc)})
+                    return
+                if route == "predict":
+                    self._predict(entry, payload)
+                else:
+                    self._ingest(entry, payload)
+
+            def _predict(self, entry, payload) -> None:
+                try:
+                    X = np.asarray(payload["rows"], np.float64)
                     if X.ndim == 1:
                         X = X[None, :]
                     tid = tracer.new_trace_id() if tracer.serve_on else None
                     with tracer.span("serve/http_request", domain="serve",
-                                     trace_id=tid, rows=int(X.shape[0])):
-                        fut = server.batcher.submit(X, trace_id=tid)
+                                     trace_id=tid, rows=int(X.shape[0]),
+                                     model=entry.model_id):
+                        fut = entry.batcher.submit(X, trace_id=tid)
                         out = fut.result(timeout=server.request_timeout_s)
                     self._json(200, {"predictions": out.tolist(),
-                                     "rows": int(X.shape[0])})
+                                     "rows": int(X.shape[0]),
+                                     "model_version":
+                                         entry.booster.inner.model_version})
+                except QueueFullError as exc:
+                    # admission control shed: fast 429 beats unbounded
+                    # queueing; clients back off or retry elsewhere
+                    self._json(429, {"error": "overloaded: %s" % exc})
+                except Exception as exc:
+                    self._json(400, {"error": "%s: %s"
+                                     % (type(exc).__name__, exc)})
+
+            def _ingest(self, entry, payload) -> None:
+                if entry.online is None:
+                    self._json(409, {"error": "online training is not "
+                                     "enabled for model %r"
+                                     % entry.model_id})
+                    return
+                try:
+                    rows = np.asarray(payload["rows"], np.float64)
+                    labels = np.asarray(payload["labels"], np.float64)
+                    buffered = entry.online.ingest(rows, labels)
+                    self._json(200, {"buffered_rows": int(buffered),
+                                     "rows": int(len(labels.ravel()))})
                 except Exception as exc:
                     self._json(400, {"error": "%s: %s"
                                      % (type(exc).__name__, exc)})
 
         self.httpd = ThreadingHTTPServer((host, int(port)), Handler)
 
+    # ---------------------------------------------------------- back-compat
+    @property
+    def session(self):
+        """Default entry's PredictSession (single-model callers)."""
+        return self.registry.get().session
+
+    @property
+    def batcher(self):
+        """Default entry's MicroBatcher (single-model callers)."""
+        return self.registry.get().batcher
+
+    @property
+    def online(self):
+        """Default entry's OnlineTrainer (None when online is off)."""
+        return self.registry.get().online
+
+    # --------------------------------------------------------------- status
     @property
     def address(self):
         """(host, port) actually bound — resolves port=0 ephemeral binds."""
         return self.httpd.server_address[:2]
 
+    def draining(self) -> bool:
+        with self._lock:
+            return self._draining
+
+    def healthz(self) -> dict:
+        """The /healthz document: substance, not a static OK — model
+        versions, registry size, queue depth, uptime and online-trainer
+        state per model."""
+        models = self.registry.info()
+        doc = {
+            "status": "draining" if self.draining() else "ok",
+            "uptime_s": round(obs.monotonic() - self._started_at, 3),
+            "model_count": len(self.registry),
+            "models": models,
+            "queue_rows": sum(m["queue_rows"] for m in models.values()),
+            "requests": telemetry.counter("serve/requests"),
+        }
+        try:
+            default = self.registry.get()
+            # single-model back-compat: the old flat fields stay
+            doc["model_version"] = default.booster.inner.model_version
+            doc["buckets"] = list(default.session.buckets)
+        except KeyError:
+            pass
+        return doc
+
+    # ------------------------------------------------------------ lifecycle
     def serve_forever(self) -> None:
         self.httpd.serve_forever()
 
@@ -125,8 +256,32 @@ class PredictServer:
         """Unblock serve_forever() (callable from any thread)."""
         self.httpd.shutdown()
 
+    def begin_shutdown(self, drain_timeout_s: float = 30.0) -> None:
+        """Graceful drain (the SIGTERM path): flip /predict//ingest to
+        503, keep the accept loop alive until the batcher queues are
+        empty (new requests are answered 503 during the drain window,
+        queued ones finish normally), then stop the accept loop. Call
+        :meth:`close` afterwards to join the workers. Safe from any
+        thread EXCEPT the one inside serve_forever (httpd.shutdown would
+        deadlock there — the CLI's signal handler hops to a helper
+        thread for exactly that reason)."""
+        with self._lock:
+            already = self._draining
+            self._draining = True
+        if already:
+            return
+        telemetry.count("serve/drain_begin")
+        Log.info("serve: draining (refusing new requests)")
+        deadline = obs.monotonic() + drain_timeout_s
+        while obs.monotonic() < deadline:
+            if all(e.batcher.queue_rows() == 0
+                   for e in self.registry.entries()):
+                break
+            time.sleep(0.01)
+        self.httpd.shutdown()
+
     def close(self) -> None:
         try:
             self.httpd.server_close()
         finally:
-            self.batcher.close()
+            self.registry.close()

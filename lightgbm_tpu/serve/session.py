@@ -127,6 +127,30 @@ class PredictSession:
                 self._warm.clear()
             return self._pack, self._has_cat
 
+    def version(self) -> int:
+        """Model-version token of the currently-resident pack (-1 before
+        the first dispatch). The online promotion gate's observable: a
+        promoted candidate moves it, a rejected one must not."""
+        with self._lock:
+            return self._version
+
+    def pack_fingerprint(self) -> str:
+        """Content hash (sha256 hex) over every array of the resident
+        pack. Test/debug hook for the online promotion contract: after a
+        REJECTED candidate the serving pack must be byte-identical, after
+        a promotion it must differ. Pulls the pack to host — never call
+        on the hot path."""
+        import hashlib
+
+        pack, _ = self._ensure_pack()
+        h = hashlib.sha256()
+        for leaf in jax.tree_util.tree_leaves(pack):
+            arr = np.asarray(leaf)  # graftlint: disable=host-sync
+            h.update(str(arr.dtype).encode())
+            h.update(str(arr.shape).encode())
+            h.update(arr.tobytes())
+        return h.hexdigest()
+
     # -------------------------------------------------------------- dispatch
     def dispatch(self, X) -> List[Tuple[jax.Array, int]]:
         """Bucketed device dispatch; returns [(device scores, real rows)].

@@ -1,11 +1,37 @@
 #!/bin/sh
-# Pre-commit gate: full-repo graftlint + the linter's own test suite.
-# Both are jax-light and finish in well under a minute on CPU.
+# Pre-commit gate, layered by cost:
+#
+#   check.sh            lint (full repo) + lint tests + the fast
+#                       serve/online/obs tier-1 subset  (~1 min CPU)
+#   check.sh --fast     lint only files changed vs git + lint tests
+#   check.sh --slo      everything above, plus the closed-loop serving
+#                       SLO bench gated against SLO_BASELINE.json
 set -e
 cd "$(dirname "$0")/.."
 
-echo "== graftlint (full repo) =="
-python scripts/lint.py
+LINT_ARGS=""
+RUN_SUBSET=1
+RUN_SLO=0
+case "$1" in
+    --fast) LINT_ARGS="--changed"; RUN_SUBSET=0 ;;
+    --slo)  RUN_SLO=1 ;;
+esac
+
+echo "== graftlint =="
+python scripts/lint.py $LINT_ARGS
 
 echo "== lint tests =="
-JAX_PLATFORMS=cpu python -m pytest tests/test_lint.py -q
+JAX_PLATFORMS=cpu python -m pytest tests/test_lint.py -q -m 'not slow'
+
+if [ "$RUN_SUBSET" = 1 ]; then
+    echo "== serve/online/obs fast tests =="
+    JAX_PLATFORMS=cpu python -m pytest -q -m 'not slow' \
+        tests/test_serve.py tests/test_online.py \
+        tests/test_obs.py tests/test_trace.py
+fi
+
+if [ "$RUN_SLO" = 1 ]; then
+    echo "== serving SLO bench (vs SLO_BASELINE.json) =="
+    JAX_PLATFORMS=cpu python scripts/slo_bench.py --quick \
+        --against SLO_BASELINE.json
+fi

@@ -1,0 +1,233 @@
+"""Closed-loop serving SLO benchmark over the real HTTP surface.
+
+Usage:
+    python scripts/slo_bench.py --quick                # CPU-sized run
+    python scripts/slo_bench.py --quick --online       # + live refit loop
+    python scripts/slo_bench.py --baseline SLO_BASELINE.json
+    python scripts/slo_bench.py --against SLO_BASELINE.json
+    python scripts/slo_bench.py --p99-target-ms 50
+
+Closed loop: N client threads POST /predict against an in-process
+``PredictServer`` on an ephemeral port, each sending its next request
+only when the previous one answered — the arrival rate adapts to the
+server, so the latency distribution is the service time under sustained
+concurrency, not queue blow-up under an arbitrary open-loop rate.
+
+With ``--online`` a labeled-ingestion thread feeds POST /ingest while the
+clients run, so the reported p99 INCLUDES background train cycles and
+promotion swaps — the number the PERF.md promotion-cost note quotes.
+
+Prints ONE JSON line (bench.py style): p50/p90/p99/p999 from the
+``serve/latency_ms`` histogram, throughput, shed/error counts, and the
+online promotion counters. Gates (exit 1 on miss): ``--p99-target-ms``
+absolute, or ``--against BASELINE.json`` relative (p99 within
+``--tolerance``x of the recorded baseline). ``--baseline PATH`` records
+the run for future ``--against`` gates.
+"""
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _client(base, n, rows, payload, fails, sheds):
+    from urllib.error import HTTPError
+    from urllib.request import Request, urlopen
+
+    for _ in range(n):
+        req = Request(base + "/predict", data=payload,
+                      headers={"Content-Type": "application/json"})
+        try:
+            with urlopen(req, timeout=60) as resp:
+                out = json.loads(resp.read())
+                if len(out["predictions"]) != rows:
+                    fails.append("short response")
+        except HTTPError as exc:
+            (sheds if exc.code == 429 else fails).append(exc.code)
+        except Exception as exc:  # noqa: BLE001 - benchmark accounting
+            fails.append(repr(exc))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="slo_bench", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--quick", action="store_true",
+                    help="small CPU-friendly workload (CI / laptops)")
+    ap.add_argument("--clients", type=int, default=None)
+    ap.add_argument("--requests", type=int, default=None,
+                    help="total requests across all clients")
+    ap.add_argument("--rows-per-request", type=int, default=8)
+    ap.add_argument("--online", action="store_true",
+                    help="run a live refit/promotion loop during the "
+                         "measurement window")
+    ap.add_argument("--max-queue-rows", type=int, default=0)
+    ap.add_argument("--p99-target-ms", type=float, default=None,
+                    help="absolute gate: exit 1 when p99 exceeds this")
+    ap.add_argument("--baseline", default=None, metavar="PATH",
+                    help="write this run's result JSON to PATH")
+    ap.add_argument("--against", default=None, metavar="PATH",
+                    help="relative gate: p99 must stay within "
+                         "--tolerance x of the recorded baseline p99")
+    ap.add_argument("--tolerance", type=float, default=5.0,
+                    help="allowed p99 ratio for --against (default 5x: "
+                         "a regression gate, not a jitter trap)")
+    args = ap.parse_args(argv)
+
+    import numpy as np
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu import obs
+    from lightgbm_tpu.obs import telemetry
+    from lightgbm_tpu.serve import PredictServer
+
+    if args.quick:
+        preset = dict(train_rows=2000, trees=20, leaves=15, features=10,
+                      clients=4, requests=240)
+    else:
+        preset = dict(train_rows=20000, trees=100, leaves=31, features=20,
+                      clients=8, requests=2000)
+    clients = args.clients or preset["clients"]
+    total = args.requests or preset["requests"]
+    rows = args.rows_per_request
+
+    rng = np.random.RandomState(0)
+    w = rng.randn(preset["features"])
+    X = rng.randn(preset["train_rows"], preset["features"])
+    y = (X @ w + 0.2 * rng.randn(len(X)) > 0).astype(np.float64)
+    bst = lgb.train({"objective": "binary", "verbosity": -1,
+                     "num_leaves": preset["leaves"]},
+                    lgb.Dataset(X, label=y),
+                    num_boost_round=preset["trees"])
+
+    online = dict(trigger_rows=max(256, rows * 8), min_rows=128,
+                  shadow_rows=1024) if args.online else None
+    server = PredictServer(bst, port=0, buckets=(64, 256), warmup=True,
+                           max_wait_ms=2.0,
+                           max_queue_rows=args.max_queue_rows,
+                           online=online)
+    host, port = server.address
+    base = "http://%s:%d" % (host, port)
+    serve_thread = threading.Thread(target=server.serve_forever,
+                                    name="slo-bench-serve", daemon=True)
+    serve_thread.start()
+
+    payload = json.dumps(
+        {"rows": rng.randn(rows, preset["features"]).tolist()}).encode()
+    fails, sheds = [], []
+    stop_ingest = threading.Event()
+
+    def ingest_loop():
+        from urllib.request import Request, urlopen
+        k = 0
+        while not stop_ingest.is_set():
+            Xi = rng.randn(64, preset["features"])
+            yi = (Xi @ w > 0).astype(np.float64)
+            req = Request(base + "/ingest",
+                          data=json.dumps({"rows": Xi.tolist(),
+                                           "labels": yi.tolist()}).encode(),
+                          headers={"Content-Type": "application/json"})
+            try:
+                urlopen(req, timeout=60).read()
+            except Exception:  # noqa: BLE001 - keep feeding
+                pass
+            k += 1
+            time.sleep(0.02)
+
+    shed0 = telemetry.counter("serve/shed")
+    req0 = telemetry.counter("serve/requests")
+    ingester = None
+    if args.online:
+        ingester = threading.Thread(target=ingest_loop,
+                                    name="slo-bench-ingest", daemon=True)
+        ingester.start()
+    threads = [threading.Thread(target=_client, name="slo-bench-c%d" % i,
+                                args=(base, total // clients, rows,
+                                      payload, fails, sheds))
+               for i in range(clients)]
+    t0 = obs.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = obs.monotonic() - t0
+    online_state = None
+    if args.online:
+        # grace window: let the background trainer land a promotion so
+        # the swap-cost histogram (the PERF.md number) gets a sample
+        deadline = obs.monotonic() + (10 if args.quick else 30)
+        while obs.monotonic() < deadline:
+            online_state = server.online.state()
+            if online_state["promotions"] >= 1 \
+                    or online_state["rejections"] >= 2:
+                break
+            time.sleep(0.1)
+    stop_ingest.set()
+    if ingester is not None:
+        ingester.join(timeout=30)
+    server.shutdown()
+    serve_thread.join(timeout=30)
+    trainer = server.online if args.online else None
+    server.close()          # joins the trainer worker: state is final
+    if trainer is not None:
+        online_state = trainer.state()
+
+    hist = telemetry.histogram("serve/latency_ms") or {}
+    swap = telemetry.histogram("online/promote_swap_ms")
+    served = telemetry.counter("serve/requests") - req0
+    result = {
+        "bench": "slo_serve",
+        "quick": bool(args.quick),
+        "clients": clients,
+        "requests": served,
+        "rows_per_request": rows,
+        "elapsed_s": round(elapsed, 3),
+        "rows_per_s": round(served * rows / max(elapsed, 1e-9), 1),
+        "latency_ms": {k: hist.get(k) for k in ("p50", "p90", "p99",
+                                                "p999")},
+        "shed": telemetry.counter("serve/shed") - shed0,
+        "client_429": len(sheds),
+        "errors": fails[:5],
+        "online": None if online_state is None else {
+            "trains": online_state["trains"],
+            "promotions": online_state["promotions"],
+            "rejections": online_state["rejections"],
+            "train_errors": online_state["errors"],
+            "promote_swap_ms": None if swap is None
+            else {k: swap.get(k) for k in ("p50", "p99")},
+        },
+    }
+
+    gate_msgs = []
+    p99 = (result["latency_ms"].get("p99") or 0.0)
+    if fails:
+        gate_msgs.append("%d request failures" % len(fails))
+    if args.p99_target_ms is not None and p99 > args.p99_target_ms:
+        gate_msgs.append("p99 %.2fms > target %.2fms"
+                         % (p99, args.p99_target_ms))
+    if args.against:
+        with open(args.against) as fh:
+            ref = json.load(fh)
+        ref_p99 = ref["latency_ms"]["p99"]
+        if ref_p99 and p99 > ref_p99 * args.tolerance:
+            gate_msgs.append("p99 %.2fms > %.1fx baseline %.2fms"
+                             % (p99, args.tolerance, ref_p99))
+        result["baseline_p99_ms"] = ref_p99
+    result["pass"] = not gate_msgs
+    if gate_msgs:
+        result["gate_failures"] = gate_msgs
+    if args.baseline:
+        with open(args.baseline, "w") as fh:
+            json.dump(result, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+    print(json.dumps(result))
+    return 0 if result["pass"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -569,6 +569,119 @@ def test_lock_discipline_init_only_writes_are_clean(tmp_path):
     assert "lock-discipline" not in rules_hit(res)
 
 
+def test_lock_discipline_confined_receiver_is_clean(tmp_path):
+    """The online-trainer shape: a worker thread builds a candidate
+    object locally and drives arbitrary unguarded mutation on it. The
+    receiver is freshly constructed in the worker's own frame, so its
+    class surface is thread-confined — no finding, even though main
+    code uses the same class."""
+    res = make_project(tmp_path, {"lightgbm_tpu/online/t.py": """\
+        import threading
+
+        class Candidate:
+            def __init__(self):
+                self.weights = []
+
+            def fit(self, x):
+                self.weights.append(x)
+
+        class Trainer:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._out = None
+                self._thread = threading.Thread(
+                    target=self._worker, name="lgbtpu-w")
+                self._thread.start()
+
+            def _worker(self):
+                c = Candidate()
+                c.fit(1)
+                with self._lock:
+                    self._out = c
+
+        def main():
+            t = Trainer()
+            c = Candidate()
+            c.fit(2)
+    """})
+    assert "lock-discipline" not in rules_hit(res), \
+        "\n".join(f.render() for f in res.findings)
+
+
+def test_lock_discipline_self_held_receiver_still_fires(tmp_path):
+    """Contrast for the confined-edge cut: the same candidate held on
+    ``self`` and mutated from the worker IS shared — the cut only
+    applies to receivers constructed in the calling frame."""
+    res = make_project(tmp_path, {"lightgbm_tpu/online/t.py": """\
+        import threading
+
+        class Candidate:
+            def __init__(self):
+                self.weights = []
+
+            def fit(self, x):
+                self.weights.append(x)
+
+        class Trainer:
+            def __init__(self):
+                self._cand = Candidate()
+                self._thread = threading.Thread(
+                    target=self._worker, name="lgbtpu-w")
+                self._thread.start()
+
+            def _worker(self):
+                self._cand.fit(1)
+
+        def main():
+            t = Trainer()
+            t._cand.fit(2)
+    """})
+    hits = [f for f in res.findings if f.rule == "lock-discipline"]
+    assert any("weights" in f.message for f in hits), \
+        "\n".join(f.render() for f in res.findings)
+
+
+def test_lock_discipline_owned_class_annotation(tmp_path):
+    """``# graftlint: owned`` on a class line exempts its fields: the
+    ownership-transfer idiom (built by one thread, published through a
+    locked handoff). The identical project without the annotation must
+    fire on the same field."""
+    src = """\
+        import threading
+
+        class Pack:{ann}
+            def __init__(self):
+                self.table = {{}}
+
+            def put(self, k, v):
+                self.table[k] = v
+
+        class Publisher:
+            def __init__(self, pack):
+                self._pack = pack
+                self._thread = threading.Thread(
+                    target=self._build, name="lgbtpu-b")
+                self._thread.start()
+
+            def _build(self):
+                self._pack.put("k", 1)
+
+        def main():
+            p = Pack()
+            pub = Publisher(p)
+            p.put("j", 2)
+    """
+    res = make_project(tmp_path / "owned", {
+        "lightgbm_tpu/online/p.py": src.format(ann="  # graftlint: owned")})
+    assert "lock-discipline" not in rules_hit(res), \
+        "\n".join(f.render() for f in res.findings)
+    res = make_project(tmp_path / "bare", {
+        "lightgbm_tpu/online/p.py": src.format(ann="")})
+    hits = [f for f in res.findings if f.rule == "lock-discipline"]
+    assert any("table" in f.message for f in hits), \
+        "\n".join(f.render() for f in res.findings)
+
+
 # ------------------------------------------------------------ unnamed-thread
 
 def test_unnamed_thread_positive(tmp_path):

@@ -1,0 +1,556 @@
+"""Online-training subsystem tests (ISSUE 8): TrafficBuffer semantics,
+shadow-scoring promotion gate, atomic hot swap, multi-tenant registry
+routing, admission control, graceful drain, and the closed-loop e2e demo
+(concurrent predict + labeled ingestion + background promotion).
+
+The promotion contract under test: a served batch always scores against
+exactly ONE whole model version (never a half-committed swap), a
+REJECTED candidate leaves the serving pack byte-identical
+(``PredictSession.pack_fingerprint``), and a promotion is a single
+version-token bump.
+"""
+import json
+import os
+import sys
+import threading
+import time
+from urllib.error import HTTPError
+from urllib.request import Request, urlopen
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import lightgbm_tpu as lgb  # noqa: E402
+from lightgbm_tpu.obs import telemetry  # noqa: E402
+from lightgbm_tpu.online import ModelRegistry, OnlineTrainer  # noqa: E402
+from lightgbm_tpu.online.buffer import TrafficBuffer  # noqa: E402
+from lightgbm_tpu.serve import MicroBatcher, PredictServer, \
+    PredictSession  # noqa: E402
+from lightgbm_tpu.serve.batcher import QueueFullError  # noqa: E402
+
+W = np.array([1.2, -0.8, 0.5, 0.0, 0.3, -0.4])
+
+
+def _data(n, seed=0, flip=0.0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, len(W))
+    y = (X @ W + 0.2 * rng.randn(n) > 0).astype(np.float64)
+    if flip:
+        m = rng.rand(n) < flip
+        y[m] = 1.0 - y[m]
+    return X, y
+
+
+def _train(n=300, seed=0, rounds=6):
+    X, y = _data(n, seed)
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "min_data_in_leaf": 5}
+    return lgb.train(params, lgb.Dataset(X, label=y),
+                     num_boost_round=rounds)
+
+
+def _post(url, obj, timeout=30):
+    req = Request(url, data=json.dumps(obj).encode(),
+                  headers={"Content-Type": "application/json"})
+    with urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _get(url, timeout=30):
+    with urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+# --------------------------------------------------------------- buffer
+
+def test_buffer_bounded_drop_oldest_and_shadow_window():
+    buf = TrafficBuffer(capacity_rows=100, shadow_rows=50)
+    for i in range(5):
+        buf.push(np.full((30, 2), i, np.float64), np.full(30, i))
+    # 150 rows pushed into a 100-row buffer: the 30-row oldest chunk
+    # drops once (120 rows, over), leaving 4 chunks = 120?  No: drops
+    # until <= capacity with at least one chunk -> 90 rows remain.
+    assert buf.rows == 90
+    assert buf.dropped_rows == 60
+    assert buf.total_rows == 150
+    # shadow window slides independently: 50-row cap -> newest chunks
+    assert buf.shadow_rows <= 60  # one chunk may straddle the cap
+    Xs, ys = buf.shadow()
+    assert set(np.unique(ys)) <= {3.0, 4.0}
+    # draining the training buffer leaves the shadow window intact
+    X, y = buf.take_training()
+    assert len(y) == 90 and buf.rows == 0
+    assert buf.take_training() is None
+    assert buf.shadow() is not None
+    # a single chunk larger than the whole buffer is kept whole
+    buf.push(np.zeros((200, 2)), np.zeros(200))
+    assert buf.rows == 200
+
+
+def test_buffer_validates_shapes():
+    buf = TrafficBuffer()
+    with pytest.raises(ValueError):
+        buf.push(np.zeros((3, 2)), np.zeros(4))
+    with pytest.raises(ValueError):
+        buf.push(np.zeros((2, 2, 2)), np.zeros(2))
+    assert buf.push(np.zeros((0, 2)), np.zeros(0)) == 0
+    # a 1-D row is a single-row batch
+    assert buf.push(np.zeros(4), [1.0]) == 1
+
+
+# ------------------------------------------------------- trainer cycle
+
+def test_run_once_skips_below_min_rows_and_restores_buffer():
+    bst = _train()
+    tr = OnlineTrainer(bst, trigger_rows=1000, min_rows=64, start=False)
+    X, y = _data(20, seed=3)
+    tr.ingest(X, y)
+    assert tr.run_once() == "skipped"
+    # the drained-but-insufficient rows go back for the next cycle
+    assert tr.buffer.rows == 20
+    assert tr.state()["last_result"] == "skipped"
+
+
+def test_refit_promotion_bumps_version_once_and_serves_new_model():
+    bst = _train()
+    sess = PredictSession(bst, buckets=(64,))
+    Xq = _data(32, seed=9)[0]
+    before = np.asarray(sess.predict(Xq))
+    v0 = bst.inner.model_version
+    tr = OnlineTrainer(bst, mode="refit", trigger_rows=100, min_rows=32,
+                       shadow_rows=256, start=False)
+    promos0 = telemetry.counter("online/promotions")
+    Xn, yn = _data(200, seed=4)
+    tr.ingest(Xn, yn)
+    assert tr.run_once() == "promoted"
+    # adopt is a SINGLE version-token bump on the served booster
+    assert bst.inner.model_version == v0 + 1
+    assert telemetry.counter("online/promotions") == promos0 + 1
+    st = tr.state()
+    assert st["promotions"] == 1 and st["can_rollback"]
+    assert st["last_losses"]["candidate"] <= st["last_losses"]["current"]
+    # the resident session picks the refit leaves up on its next dispatch
+    after = np.asarray(sess.predict(Xq))
+    assert not np.allclose(before, after)
+    ref = np.asarray(bst.predict(Xq))
+    np.testing.assert_allclose(after, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_shadow_gate_rejects_degraded_candidate_pack_identical():
+    bst = _train(seed=1)
+    sess = PredictSession(bst, buckets=(64,))
+    sess.predict(_data(8, seed=5)[0])          # make the pack resident
+    fp0 = sess.pack_fingerprint()
+    v0 = bst.inner.model_version
+
+    def degraded(X, y):
+        cand = lgb.Booster(model_str=bst.model_to_string())
+        for t in cand.inner.models:
+            t.leaf_value[:] = 1e3              # maximally wrong leaves
+        cand.inner._bump_model_version()
+        return cand
+
+    tr = OnlineTrainer(bst, trigger_rows=100, min_rows=32,
+                       candidate_factory=degraded, start=False)
+    rej0 = telemetry.counter("online/rejections")
+    Xn, yn = _data(200, seed=6)
+    tr.ingest(Xn, yn)
+    assert tr.run_once() == "rejected"
+    assert telemetry.counter("online/rejections") == rej0 + 1
+    assert bst.inner.model_version == v0
+    # the promotion contract: a rejected candidate leaves the serving
+    # pack byte-identical
+    assert sess.pack_fingerprint() == fp0
+    st = tr.state()
+    assert st["rejections"] == 1 and st["last_result"] == "rejected"
+    assert st["last_losses"]["candidate"] > st["last_losses"]["current"]
+
+
+def test_promote_threshold_zero_rejects_everything():
+    bst = _train(seed=2)
+    tr = OnlineTrainer(bst, trigger_rows=100, min_rows=32,
+                       promote_threshold=0.0, start=False)
+    Xn, yn = _data(200, seed=7)
+    tr.ingest(Xn, yn)
+    assert tr.run_once() == "rejected"
+
+
+def test_continue_mode_adds_rounds():
+    bst = _train(seed=3, rounds=4)
+    n0 = len(bst.inner.models)
+    tr = OnlineTrainer(bst, mode="continue", continue_rounds=2,
+                       trigger_rows=100, min_rows=32, start=False)
+    Xn, yn = _data(256, seed=8)
+    tr.ingest(Xn, yn)
+    assert tr.run_once() == "promoted"
+    assert len(bst.inner.models) == n0 + 2
+
+
+def test_rollback_restores_previous_model():
+    bst = _train(seed=4)
+    # generous gate: this test is about the swap mechanics, not scoring
+    tr = OnlineTrainer(bst, trigger_rows=100, min_rows=32,
+                       promote_threshold=1.25, start=False)
+    s_before = bst.model_to_string()
+    Xn, yn = _data(200, seed=9)
+    tr.ingest(Xn, yn)
+    assert tr.run_once() == "promoted"
+    assert bst.model_to_string() != s_before
+    assert tr.rollback()
+    assert bst.model_to_string() == s_before
+    assert not tr.rollback()                  # token is single-use
+    # the trainer's snapshot cache rewinds with the swap
+    Xn2, yn2 = _data(200, seed=10)
+    tr.ingest(Xn2, yn2)
+    assert tr.run_once() in ("promoted", "rejected")
+
+
+def test_worker_thread_triggers_on_row_count():
+    bst = _train(seed=5)
+    tr = OnlineTrainer(bst, trigger_rows=128, min_rows=64,
+                       shadow_rows=256, start=True)
+    try:
+        v0 = bst.inner.model_version
+        Xn, yn = _data(256, seed=11)
+        tr.ingest(Xn, yn)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            st = tr.state()
+            if st["trains"] >= 1:
+                break
+            time.sleep(0.05)
+        st = tr.state()
+        assert st["trains"] >= 1, st
+        assert st["errors"] == 0, st["last_error"]
+        if st["promotions"]:
+            assert bst.inner.model_version > v0
+    finally:
+        tr.close(timeout=30)
+    assert tr.state()["running"] is False
+
+
+def test_atomic_swap_every_batch_is_one_whole_version():
+    """Serve threads hammer the session while the main thread promotes
+    repeatedly: every observed output must equal the model at one whole
+    version — a torn swap matches neither side."""
+    bst = _train(seed=6)
+    sess = PredictSession(bst, buckets=(64,))
+    Xq = np.ascontiguousarray(_data(16, seed=12)[0])
+    expected = {bst.inner.model_version: np.asarray(bst.predict(Xq))}
+    observed = []
+    stop = threading.Event()
+
+    def serve():
+        while not stop.is_set() and len(observed) < 300:
+            v0 = bst.inner.model_version
+            out = np.asarray(sess.predict(Xq), np.float64)
+            v1 = bst.inner.model_version
+            observed.append((v0, out, v1))
+
+    th = threading.Thread(target=serve, name="online-test-serve")
+    th.start()
+    try:
+        tr = OnlineTrainer(bst, trigger_rows=64, min_rows=32,
+                           shadow_rows=128, start=False)
+        for i in range(4):
+            Xn, yn = _data(96, seed=20 + i)
+            tr.ingest(Xn, yn)
+            tr.run_once()
+            expected[bst.inner.model_version] = np.asarray(bst.predict(Xq))
+    finally:
+        stop.set()
+        th.join(timeout=60)
+    assert not th.is_alive() and observed
+    assert len(expected) >= 2          # at least one promotion happened
+    for v0, out, v1 in observed:
+        ok = any(v in expected and np.allclose(out, expected[v],
+                                               rtol=1e-5, atol=1e-6)
+                 for v in range(v0, v1 + 1))
+        assert ok, "batch matches no whole version in [%d, %d]" % (v0, v1)
+
+
+# ---------------------------------------------------- admission control
+
+class _SlowSession:
+    """MicroBatcher-shaped fake: dispatch sleeps, predictions are row
+    sums (so slicing bugs would show)."""
+
+    buckets = (64,)
+
+    def __init__(self, delay=0.05):
+        self.delay = delay
+
+    def dispatch(self, X):
+        time.sleep(self.delay)
+        return [(np.asarray(X).sum(axis=1), len(X))]
+
+    def finalize(self, raw, raw_score=False):
+        return np.asarray(raw)
+
+
+def test_admission_control_shed_raises_and_counts():
+    shed0 = telemetry.counter("serve/shed")
+    b = MicroBatcher(_SlowSession(0.2), max_batch_rows=8, max_wait_ms=1.0,
+                     max_queue_rows=8, overload="shed")
+    try:
+        futs = [b.submit(np.ones((8, 4)))]     # occupies the worker
+        time.sleep(0.05)
+        futs.append(b.submit(np.ones((8, 4)))) # fills the queue
+        with pytest.raises(QueueFullError):
+            for _ in range(20):
+                futs.append(b.submit(np.ones((8, 4))))
+        for f in futs:
+            np.testing.assert_allclose(f.result(timeout=30), 4.0)
+    finally:
+        b.close()
+    assert telemetry.counter("serve/shed") >= shed0 + 1
+
+
+def test_admission_control_block_waits_and_completes():
+    b = MicroBatcher(_SlowSession(0.02), max_batch_rows=8, max_wait_ms=1.0,
+                     max_queue_rows=8, overload="block")
+    try:
+        futs = [b.submit(np.full((4, 4), i, np.float64))
+                for i in range(12)]
+        for i, f in enumerate(futs):
+            np.testing.assert_allclose(f.result(timeout=60), 4.0 * i)
+    finally:
+        b.close()
+
+
+def test_oversize_single_request_admitted_when_queue_empty():
+    b = MicroBatcher(_SlowSession(0.0), max_batch_rows=64, max_wait_ms=1.0,
+                     max_queue_rows=8, overload="shed")
+    try:
+        f = b.submit(np.ones((32, 4)))         # larger than the bound
+        assert len(f.result(timeout=30)) == 32
+    finally:
+        b.close()
+
+
+# ------------------------------------------------- multi-tenant serving
+
+def _start_server(server):
+    th = threading.Thread(target=server.serve_forever,
+                          name="online-test-http", daemon=True)
+    th.start()
+    return th
+
+
+def test_multi_tenant_routing_healthz_and_ingest_409():
+    clf = _train(seed=7)
+    Xr = _data(200, seed=13)[0]
+    reg_bst = lgb.train({"objective": "regression", "num_leaves": 7,
+                         "verbosity": -1, "min_data_in_leaf": 5},
+                        lgb.Dataset(Xr, label=Xr @ W),
+                        num_boost_round=4)
+    registry = ModelRegistry()
+    registry.register("clf", clf, buckets=(64,))
+    registry.register("reg", reg_bst, buckets=(64,))
+    server = PredictServer(registry=registry, port=0)
+    host, port = server.address
+    base = "http://%s:%d" % (host, port)
+    th = _start_server(server)
+    try:
+        Xq = _data(5, seed=14)[0]
+        code, out = _post(base + "/predict/clf", {"rows": Xq.tolist()})
+        assert code == 200 and out["rows"] == 5
+        np.testing.assert_allclose(out["predictions"],
+                                   np.asarray(clf.predict(Xq)),
+                                   rtol=1e-5, atol=1e-6)
+        # routing via request body
+        code, out2 = _post(base + "/predict", {"rows": Xq.tolist(),
+                                               "model": "reg"})
+        assert code == 200
+        np.testing.assert_allclose(out2["predictions"],
+                                   np.asarray(reg_bst.predict(Xq)),
+                                   rtol=1e-4, atol=1e-5)
+        # two models, no "default": an id is required
+        with pytest.raises(HTTPError) as ei:
+            _post(base + "/predict", {"rows": Xq.tolist()})
+        assert ei.value.code == 404
+        with pytest.raises(HTTPError) as ei:
+            _post(base + "/predict/nope", {"rows": Xq.tolist()})
+        assert ei.value.code == 404
+        assert "nope" in json.loads(ei.value.read())["error"]
+        # ingest without online training on the target model
+        with pytest.raises(HTTPError) as ei:
+            _post(base + "/ingest/clf", {"rows": Xq.tolist(),
+                                         "labels": [1] * 5})
+        assert ei.value.code == 409
+        assert sorted(_get(base + "/models")["models"]) == ["clf", "reg"]
+        health = _get(base + "/healthz")
+        assert health["status"] == "ok"
+        assert health["model_count"] == 2
+        assert set(health["models"]) == {"clf", "reg"}
+        for m in health["models"].values():
+            assert m["model_version"] >= 1
+            assert m["queue_rows"] == 0
+            assert m["online"] is None
+        assert health["uptime_s"] >= 0
+        assert health["queue_rows"] == 0
+    finally:
+        server.shutdown()
+        th.join(timeout=10)
+        server.close()
+
+
+def test_graceful_drain_503_and_queued_work_completes():
+    registry = ModelRegistry()
+    from lightgbm_tpu.online.registry import RegistryEntry
+
+    class _B:                                   # booster stub for /healthz
+        class inner:
+            model_version = 1
+    sess = _SlowSession(0.3)
+    batcher = MicroBatcher(sess, max_batch_rows=8, max_wait_ms=1.0)
+    registry.add_entry(RegistryEntry("default", _B(), sess, batcher))
+    server = PredictServer(registry=registry, port=0)
+    host, port = server.address
+    base = "http://%s:%d" % (host, port)
+    th = _start_server(server)
+    drain0 = telemetry.counter("serve/drain_rejected")
+    results = {}
+
+    def post_slow(key):
+        try:
+            results[key] = _post(base + "/predict",
+                                 {"rows": [[1.0] * 4] * 8})
+        except HTTPError as exc:
+            results[key] = (exc.code, json.loads(exc.read()))
+
+    try:
+        # A occupies the worker; B sits in the queue and holds the
+        # drain window open until the worker reaches it
+        t1 = threading.Thread(target=post_slow, args=("inflight",))
+        t1.start()
+        time.sleep(0.1)
+        t2 = threading.Thread(target=post_slow, args=("queued",))
+        t2.start()
+        time.sleep(0.05)
+        drainer = threading.Thread(target=server.begin_shutdown,
+                                   name="online-test-drain")
+        drainer.start()
+        time.sleep(0.05)                    # drain flag is up
+        post_slow("during_drain")
+        t1.join(timeout=30)
+        t2.join(timeout=30)
+        drainer.join(timeout=30)
+        assert results["during_drain"][0] == 503
+        for key in ("inflight", "queued"):  # admitted work finished
+            assert results[key][0] == 200, results[key]
+            np.testing.assert_allclose(results[key][1]["predictions"], 4.0)
+        assert telemetry.counter("serve/drain_rejected") >= drain0 + 1
+        th.join(timeout=10)                 # serve_forever returned
+        assert not th.is_alive()
+    finally:
+        server.close()
+
+
+def test_e2e_concurrent_predict_ingest_promotion_zero_failures():
+    """The acceptance demo: live /predict traffic + labeled /ingest with
+    a background trainer; at least one promotion lands, no request ever
+    fails, and /metrics exposes the online counter families."""
+    bst = _train(seed=8)
+    server = PredictServer(bst, port=0, buckets=(64,), max_wait_ms=1.0,
+                           online=dict(trigger_rows=64, min_rows=32,
+                                       shadow_rows=256))
+    host, port = server.address
+    base = "http://%s:%d" % (host, port)
+    th = _start_server(server)
+    failures = []
+    stop = threading.Event()
+    Xq = _data(8, seed=15)[0]
+
+    def predict_loop():
+        while not stop.is_set():
+            try:
+                code, out = _post(base + "/predict", {"rows": Xq.tolist()})
+                if code != 200 or len(out["predictions"]) != 8:
+                    failures.append(out)
+            except Exception as exc:        # noqa: BLE001 - record all
+                failures.append(repr(exc))
+            time.sleep(0.005)
+
+    preds = [threading.Thread(target=predict_loop,
+                              name="online-e2e-pred-%d" % i)
+             for i in range(2)]
+    for p in preds:
+        p.start()
+    try:
+        deadline = time.monotonic() + 90
+        seed = 30
+        while time.monotonic() < deadline:
+            Xn, yn = _data(48, seed=seed)
+            seed += 1
+            code, _ = _post(base + "/ingest", {"rows": Xn.tolist(),
+                                               "labels": yn.tolist()})
+            assert code == 200
+            st = _get(base + "/healthz")["models"]["default"]["online"]
+            if st["promotions"] >= 1:
+                break
+            time.sleep(0.1)
+        assert st["promotions"] >= 1, st
+        assert st["errors"] == 0, st["last_error"]
+    finally:
+        stop.set()
+        for p in preds:
+            p.join(timeout=30)
+        server.shutdown()
+        th.join(timeout=10)
+        server.close()
+    assert not failures, failures[:3]
+    # the whole counter family is on /metrics (pre-touched at trainer
+    # start so dashboards see the series even before the first cycle)
+    from lightgbm_tpu import obs
+    text = obs.prometheus_text()
+    for name in ("lgbtpu_online_promotions_total",
+                 "lgbtpu_online_rejections_total",
+                 "lgbtpu_serve_shed_total",
+                 "lgbtpu_serve_queue_depth_rows"):
+        assert name in text, name
+
+
+@pytest.mark.slow
+def test_cli_sigterm_drains_dumps_and_exits_zero(tmp_path):
+    """task=serve wired end to end: SIGTERM -> drain -> telemetry dump
+    -> exit 0."""
+    import signal
+    import subprocess
+
+    bst = _train(seed=9)
+    model = tmp_path / "m.txt"
+    bst.save_model(str(model))
+    dump = tmp_path / "telemetry.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "lightgbm_tpu.cli", "task=serve",
+         "input_model=%s" % model, "serve_port=0", "verbosity=1",
+         "--dump-telemetry", str(dump)],
+        cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+    try:
+        port = None
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline and port is None:
+            line = proc.stdout.readline()
+            if "http://" in line:
+                port = int(line.rsplit(":", 1)[1].split()[0].strip("/"))
+        assert port, "server never reported its address"
+        base = "http://127.0.0.1:%d" % port
+        code, out = _post(base + "/predict",
+                          {"rows": _data(3, seed=16)[0].tolist()})
+        assert code == 200 and out["rows"] == 3
+        assert _get(base + "/healthz")["status"] == "ok"
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=60) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+    snap = json.loads(dump.read_text())
+    assert snap["counters"].get("serve/requests", 0) >= 1
+    assert snap["counters"].get("serve/drain_begin", 0) >= 1

@@ -245,6 +245,17 @@ def test_http_server_roundtrip():
         with urlopen("http://%s:%d/healthz" % (host, port), timeout=30) as r:
             health = json.loads(r.read())
         assert health["status"] == "ok"
+        # /healthz carries substance: registry + per-model state (the
+        # single-model server registers its booster as "default")
+        assert health["model_count"] == 1
+        assert health["uptime_s"] >= 0
+        assert health["queue_rows"] == 0
+        info = health["models"]["default"]
+        assert info["model_version"] == bst.inner.model_version
+        assert info["queue_rows"] == 0 and info["age_s"] >= 0
+        assert info["online"] is None
+        assert health["model_version"] == bst.inner.model_version
+        assert health["buckets"] == [64]
         with urlopen("http://%s:%d/telemetry" % (host, port), timeout=30) as r:
             snap = json.loads(r.read())
         assert snap["counters"].get("serve/requests", 0) >= 1
