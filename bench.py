@@ -32,6 +32,9 @@ RANK_ITER = int(os.environ.get("BENCH_RANK_ITERS", 30))
 SKIP_RANK = os.environ.get("BENCH_SKIP_RANK", "") == "1"
 SKIP_2M = os.environ.get("BENCH_SKIP_2M", "") == "1"
 SKIP_SERVE = os.environ.get("BENCH_SKIP_SERVE", "") == "1"
+SKIP_LINEAR = os.environ.get("BENCH_SKIP_LINEAR", "") == "1"
+LINEAR_ROWS = int(os.environ.get("BENCH_LINEAR_ROWS", 500_000))
+LINEAR_ITER = int(os.environ.get("BENCH_LINEAR_ITERS", 15))
 # non-empty = record host spans (trace_spans=on) and write the flight
 # recorder as Chrome trace-event JSON (Perfetto-loadable) to this path
 TRACE_PATH = os.environ.get("BENCH_TRACE", "")
@@ -176,6 +179,43 @@ def run_mslr(lgb, timer):
             t_gen, t_cons, phases)
 
 
+def run_linear(lgb):
+    """Piecewise-linear leaf trees: full-train wall with the host per-leaf
+    solve loop (linear_device=off) vs the batched device fit (on), plus
+    prediction parity between the two models. Kernel-level A/B with
+    measurement discipline lives in scripts/linear_bisect.py."""
+    from lightgbm_tpu import obs
+    rng = np.random.RandomState(17)
+    X = rng.randn(LINEAR_ROWS, 28)
+    w = rng.randn(28) / np.sqrt(28)
+    y = X @ w + 0.5 * np.sin(2 * X[:, 0]) + 0.1 * rng.randn(LINEAR_ROWS)
+    params = {"objective": "regression", "num_leaves": 63, "max_bin": 255,
+              "learning_rate": 0.1, "verbosity": -1, "linear_tree": True,
+              "linear_lambda": 0.01}
+    out = {}
+    boosters = {}
+    for dev in ("off", "on"):
+        p = dict(params, linear_device=dev)
+        ds = lgb.Dataset(X, label=y, params=dict(p))
+        ds.construct()
+        lgb.train(dict(p), ds, num_boost_round=3)          # warmup/compile
+        with obs.wall("linear/train_" + dev) as wl:
+            bst = lgb.train(dict(p), ds, num_boost_round=LINEAR_ITER)
+            obs.sync(bst.inner.train_score.score)
+        out[dev] = wl.seconds
+        boosters[dev] = bst
+    pred_off = boosters["off"].predict(X[:4096])
+    pred_on = boosters["on"].predict(X[:4096])
+    return {
+        "linear_train_off_s": round(out["off"], 3),
+        "linear_train_on_s": round(out["on"], 3),
+        "linear_device_speedup": round(out["off"] / max(out["on"], 1e-9), 3),
+        "linear_pred_maxdiff": float(np.max(np.abs(pred_off - pred_on))),
+        "linear_unit": "train wall s (N=%d F=28 leaves=63 iters=%d)"
+                       % (LINEAR_ROWS, LINEAR_ITER),
+    }
+
+
 def main():
     import jax
     jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
@@ -247,6 +287,12 @@ def main():
         except Exception as e:  # pragma: no cover - report, don't fail
             result["serve_error"] = "%s: %s" % (type(e).__name__,
                                                 str(e)[:200])
+    if not SKIP_LINEAR:
+        try:
+            result.update(run_linear(lgb))
+        except Exception as e:  # pragma: no cover - report, don't fail
+            result["linear_error"] = "%s: %s" % (type(e).__name__,
+                                                 str(e)[:200])
     # full structured-counter view of the run (dataset cache traffic, fused
     # dispatch/flush, per-tree growth, auto-knob resolutions, bench walls)
     result["telemetry"] = lgb.obs.telemetry.snapshot()

@@ -441,6 +441,13 @@ class Booster:
                         new_val = -g[m].sum() / (h[m].sum() + lam)
                         tree.leaf_value[l] = decay * tree.leaf_value[l] + \
                             (1 - decay) * new_val * tree.shrinkage
+                        if getattr(tree, "is_linear", False):
+                            # linear leaves OUTPUT leaf_const (+ coeffs);
+                            # decay it the same way or refit would only
+                            # move the NaN-fallback value
+                            tree.leaf_const[l] = \
+                                decay * tree.leaf_const[l] + \
+                                (1 - decay) * new_val * tree.shrinkage
                 score[:, i % K] += tree.predict(X)
             # leaf values were rewritten in place on the fresh booster's trees
             new_booster.inner._bump_model_version()

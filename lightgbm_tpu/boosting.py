@@ -358,7 +358,11 @@ class GBDT:
         solve -(Z^T H Z + lambda I') beta = Z^T g per leaf over the leaf's
         branch numerical features; rows with NaN in those features are
         excluded; under-determined leaves keep the plain output. The first
-        iteration only copies constants (the reference skips the fit)."""
+        iteration only copies constants (the reference skips the fit).
+
+        Under ``linear_device`` the solve runs batched on device
+        (lightgbm_tpu/linear/fit.py: all leaves' Gram matrices at once);
+        this host loop stays as the parity oracle."""
         from .ops.binning import BIN_CATEGORICAL
 
         ds = self.train_set
@@ -370,6 +374,12 @@ class GBDT:
                 or tree.num_leaves <= 1 or ds.raw_numeric is None:
             return
         lam = float(self.config.linear_lambda)
+        if self._linear_fit_on_device():
+            from .linear import fit_linear_leaves
+            fit_linear_leaves(tree, ds, log.row_leaf, self._last_ghc,
+                              lam=lam, rate=rate,
+                              num_leaves_cap=int(self.config.num_leaves))
+            return
         leaf = np.asarray(log.row_leaf)
         # use the bagged/amplified channels the tree was grown on (reference
         # fits over the bagged partition only; out-of-bag rows carry h=0
@@ -404,6 +414,17 @@ class GBDT:
             tree.leaf_coeff[l] = beta[:-1][keep] * rate
             tree.leaf_const[l] = float(beta[-1]) * rate
 
+    def _linear_fit_on_device(self) -> bool:
+        """Resolve ``linear_device``: off -> host oracle, on -> batched
+        device solve, auto -> device only when a TPU backend is up (the
+        host loop beats a CPU-jax round trip at small leaf counts)."""
+        mode = self.config.linear_device
+        if mode == "off":
+            return False
+        if mode == "on":
+            return True
+        return jax.default_backend() == "tpu"
+
     def _linear_score_updates(self, tree: Tree, log: TreeLog,
                               class_id: int) -> None:
         """Score updates for linear leaves need raw feature values, so they
@@ -426,8 +447,12 @@ class GBDT:
                 vscore.add(slot_vals, vleaf, class_id,
                            self.num_tree_per_iteration)
                 continue
+            # the device router returns to_split_arrays SLOTS (BFS order);
+            # linear_predict keys coefficients by LEAF id — map through
+            # leaf_of_slot (they only coincide when BFS == creation order)
+            leaf_of_slot = tree.to_split_arrays()["leaf_of_slot"]
             vvals = tree.linear_predict(vset.raw_numeric.astype(np.float64),
-                                        np.asarray(vleaf))
+                                        leaf_of_slot[np.asarray(vleaf)])
             vscore.score = vscore.score + (
                 jnp.asarray(vvals, jnp.float32)
                 if self.num_tree_per_iteration == 1
@@ -733,8 +758,7 @@ class GBDT:
         # candidates from its worker thread while promotions mutate models
         with self._cache_lock:
             models = self.models[start * K:end * K]
-        has_linear = any(getattr(t, "is_linear", False) for t in models)
-        if n >= self.DEVICE_PREDICT_MIN_ROWS and models and not has_linear:
+        if n >= self.DEVICE_PREDICT_MIN_ROWS and models:
             return self._predict_session(start, end).raw_scores(X)
         score = np.zeros((n, K), dtype=np.float64)
         for i, t in enumerate(models):
@@ -838,9 +862,6 @@ class GBDT:
         if num_iteration is None or num_iteration <= 0:
             num_iteration = total_iters
         end = min(total_iters, num_iteration) * K
-        if any(getattr(t, "is_linear", False) for t in self.models[:end]):
-            Log.fatal("convert_model does not support linear trees "
-                      "(leaf linear terms); disable linear_tree")
         parts = [
             "// generated by lightgbm_tpu task=convert_model",
             "#include <cmath>",

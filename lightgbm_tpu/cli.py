@@ -208,7 +208,8 @@ class Application:
                 promote_threshold=cfg.online_promote_threshold,
                 min_rows=cfg.online_min_rows,
                 continue_rounds=cfg.online_continue_rounds,
-                decay_rate=cfg.refit_decay_rate)
+                decay_rate=cfg.refit_decay_rate,
+                shadow_decay=cfg.online_shadow_decay)
         from .online import ModelRegistry
         from .serve.http import PredictServer
         registry = ModelRegistry()

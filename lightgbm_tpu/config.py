@@ -151,6 +151,9 @@ class Config:
     # ---- linear tree ----
     linear_tree: bool = False
     linear_lambda: float = 0.0
+    # leaf fit path: auto (device when a TPU backend is up, host otherwise)
+    # | off (host NumPy oracle) | on (batched device solve, any backend)
+    linear_device: str = "auto"
 
     # ---- dataset (reference: config.h "IO Parameters / Dataset") ----
     max_bin: int = 255
@@ -215,6 +218,9 @@ class Config:
     #   threshold * current_loss on the shadow window (1.0 = "not worse")
     online_min_rows: int = 64         # never train on fewer buffered rows
     online_continue_rounds: int = 10  # boosting rounds per continue-mode run
+    online_shadow_decay: float = 1.0  # per-row exponential decay toward the
+    #   oldest shadow row when scoring (1.0 = uniform window, current
+    #   behavior; 0<d<1 weights recent traffic more)
 
     # ---- objective (reference: config.h "Objective Parameters") ----
     num_class: int = 1
@@ -397,6 +403,12 @@ class Config:
         if self.online_continue_rounds < 1:
             Log.fatal("online_continue_rounds must be >= 1, got %d",
                       self.online_continue_rounds)
+        if not 0.0 < self.online_shadow_decay <= 1.0:
+            Log.fatal("online_shadow_decay must be in (0, 1], got %g",
+                      self.online_shadow_decay)
+        if self.linear_device not in ("auto", "off", "on"):
+            Log.fatal("linear_device must be auto, off or on; got %s",
+                      self.linear_device)
         if self.trace_spans not in ("off", "on", "serve_only"):
             Log.fatal("trace_spans must be off, on or serve_only; got %s",
                       self.trace_spans)

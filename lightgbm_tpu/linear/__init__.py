@@ -1,0 +1,21 @@
+"""TPU-native piecewise-linear leaf trees (arXiv:1802.05640).
+
+Per-leaf ridge normal equations are the one GBDT extension that is
+matmul-shaped, so this package keeps the whole linear-leaf life cycle on
+device:
+
+- :mod:`fit` — after a tree's leaves are final, accumulate ALL leaves'
+  Gram matrices/RHS at once with chunked one-hot contractions (MXU
+  matmuls, no per-leaf host loop) and solve them as one batched
+  ``jnp.linalg.solve``. The host NumPy loop in
+  ``boosting._fit_linear_tree`` stays as the parity oracle behind
+  ``linear_device=auto|off|on``.
+- :mod:`pack` — slot-ordered per-leaf coefficient tables riding inside
+  ``ops.predict.PackedSplits`` so device predict (and the serve/ bucket
+  ladder) evaluates linear leaves as a leaf-indexed coefficient gather
+  plus a feature dot.
+"""
+from .fit import fit_linear_leaves
+from .pack import linear_pack_arrays, linear_values_by_row
+
+__all__ = ["fit_linear_leaves", "linear_pack_arrays", "linear_values_by_row"]

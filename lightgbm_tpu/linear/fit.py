@@ -1,0 +1,200 @@
+"""Batched device fit of ridge linear leaf models.
+
+Replaces the host oracle's per-leaf ``np.linalg.solve`` loop
+(``boosting._fit_linear_tree``) with one device program per tree: every
+leaf's normal equations ``-(Z^T H Z + lambda I') beta = Z^T g`` are
+accumulated simultaneously by chunked one-hot contractions — per chunk,
+the weighted outer products ``(C, k+1, k+1)`` flatten to
+``(C, (k+1)^2)`` and a ``(L, C) x (C, (k+1)^2)`` matmul segment-sums them
+into the stacked Gram matrices — then solved with a single batched
+``jnp.linalg.solve``. Both contractions are MXU-shaped; nothing scales
+with the leaf count on the host side.
+
+Parity contract with the oracle (tests/test_linear_device.py):
+
+- only branch-path NUMERICAL features enter a leaf's model;
+- rows with NaN in any of the leaf's features are excluded from its
+  normal equations (weight and z zeroed — identical contributions);
+- ridge ``linear_lambda`` lands on feature diagonals only, never the
+  intercept;
+- a leaf is fit only when it has features and at least ``k+1`` total AND
+  NaN-free rows; everything else keeps the plain constant output (the
+  host path's ``continue``); a non-finite batched-solve row (the host
+  path's ``LinAlgError``) falls back the same way.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..obs import telemetry, track_jit
+from ..obs_trace import tracer
+
+#: rows per accumulation step: big enough to keep the (L, C) x (C, k^2)
+#: contractions bandwidth-bound, small enough that the (C, (k+1)^2)
+#: flattened outer products stay far from VMEM pressure
+_CHUNK = 8192
+
+
+def leaf_feature_table(tree, ds, num_leaves_cap: int
+                       ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Per-leaf branch-path numerical features as padded index + mask
+    tables (Lp, kp): the same feature filter as the host oracle
+    (categorical and pre-filtered columns excluded). Feature axis pads to
+    a power of two and the leaf axis to ``num_leaves_cap`` so the fit
+    kernel compiles a handful of signatures per run instead of one per
+    tree shape. None when no leaf has any usable feature."""
+    from ..ops.binning import BIN_CATEGORICAL
+
+    per_leaf = []
+    kmax = 0
+    for l in range(tree.num_leaves):
+        feats = [int(f) for f in tree.branch_features(l)
+                 if ds.inner_feature_index(int(f)) >= 0
+                 and ds.bin_mappers[ds.inner_feature_index(int(f))]
+                 .bin_type != BIN_CATEGORICAL]
+        per_leaf.append(feats)
+        kmax = max(kmax, len(feats))
+    if kmax == 0:
+        return None
+    kp = 1
+    while kp < kmax:
+        kp *= 2
+    Lp = max(int(num_leaves_cap), tree.num_leaves)
+    feat_idx = np.zeros((Lp, kp), np.int32)
+    feat_mask = np.zeros((Lp, kp), bool)
+    for l, feats in enumerate(per_leaf):
+        feat_idx[l, :len(feats)] = feats
+        feat_mask[l, :len(feats)] = True
+    return feat_idx, feat_mask
+
+
+def fit_leaves_impl(X: jax.Array, row_leaf: jax.Array, g: jax.Array,
+                    h: jax.Array, feat_idx: jax.Array, feat_mask: jax.Array,
+                    lam: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """All leaves' ridge solves in one program.
+
+    ``X`` (N, F) raw feature values (NaN kept), ``row_leaf`` (N,) i32 leaf
+    assignment, ``g``/``h`` (N,) gradient/hessian channels (out-of-bag
+    rows carry zeros and drop out of the sums), ``feat_idx``/``feat_mask``
+    (L, k) per-leaf feature tables. Returns ``beta`` (L, k+1) with the
+    intercept last and ``fit_ok`` (L,) — leaves whose solution is valid.
+    """
+    L, km = feat_idx.shape
+    kp1 = km + 1
+    n = row_leaf.shape[0]
+    f32 = jnp.float32
+
+    fi = jnp.take(feat_idx, row_leaf, axis=0)              # (N, km)
+    fm = jnp.take(feat_mask, row_leaf, axis=0)             # (N, km)
+    z = jnp.take_along_axis(X.astype(f32), fi, axis=1)     # (N, km)
+    nan = jnp.isnan(z)
+    valid = jnp.logical_not(jnp.any(nan & fm, axis=1)).astype(f32)
+    z = jnp.where(fm & jnp.logical_not(nan), z, f32(0))
+    wh = h.astype(f32) * valid
+    wg = g.astype(f32) * valid
+
+    pad = (-n) % _CHUNK
+    if pad:
+        # pad rows route to leaf slot L: their one-hot row is all-zero, so
+        # they fall out of every sum including the row counts
+        z = jnp.concatenate([z, jnp.zeros((pad, km), f32)])
+        row_leaf = jnp.concatenate(
+            [row_leaf, jnp.full((pad,), L, row_leaf.dtype)])
+        wh = jnp.concatenate([wh, jnp.zeros((pad,), f32)])
+        wg = jnp.concatenate([wg, jnp.zeros((pad,), f32)])
+        valid = jnp.concatenate([valid, jnp.zeros((pad,), f32)])
+    nc = (n + pad) // _CHUNK
+    iota = jnp.arange(L, dtype=row_leaf.dtype)
+
+    def dot(a, b):
+        return jax.lax.dot(a, b, precision=jax.lax.Precision.HIGHEST,
+                           preferred_element_type=f32)
+
+    def one_chunk(carry, xs):
+        A, B, cnt, vcnt = carry
+        z_c, rl_c, wh_c, wg_c, v_c = xs
+        zk = jnp.concatenate([z_c, jnp.ones((_CHUNK, 1), f32)], axis=1)
+        oh = (rl_c[:, None] == iota[None, :]).astype(f32)  # (C, L)
+        outer = (zk[:, :, None] * zk[:, None, :]) * wh_c[:, None, None]
+        A = A + dot(oh.T, outer.reshape(_CHUNK, kp1 * kp1))
+        B = B + dot(oh.T, zk * wg_c[:, None])
+        cnt = cnt + jnp.sum(oh, axis=0)
+        vcnt = vcnt + dot(oh.T, v_c[:, None])[:, 0]
+        return (A, B, cnt, vcnt), None
+
+    carry0 = (jnp.zeros((L, kp1 * kp1), f32), jnp.zeros((L, kp1), f32),
+              jnp.zeros((L,), f32), jnp.zeros((L,), f32))
+    xs = (z.reshape(nc, _CHUNK, km), row_leaf.reshape(nc, _CHUNK),
+          wh.reshape(nc, _CHUNK), wg.reshape(nc, _CHUNK),
+          valid.reshape(nc, _CHUNK))
+    (A, B, cnt, vcnt), _ = jax.lax.scan(one_chunk, carry0, xs)
+
+    A = A.reshape(L, kp1, kp1)
+    # ridge on real feature dims only (never the intercept); padded dims
+    # carry all-zero rows/columns, so a unit diagonal keeps the batched
+    # solve nonsingular there while their zero RHS still yields beta == 0
+    diag = jnp.concatenate(
+        [jnp.where(feat_mask, lam.astype(f32), f32(1)),
+         jnp.zeros((L, 1), f32)], axis=1)
+    A = A + diag[:, :, None] * jnp.eye(kp1, dtype=f32)[None, :, :]
+    beta = -jnp.linalg.solve(A, B[:, :, None])[:, :, 0]
+    k_l = jnp.sum(feat_mask.astype(f32), axis=1)
+    fit_ok = (k_l > f32(0)) & (cnt >= k_l + f32(1)) & (vcnt >= k_l + f32(1))
+    fit_ok = fit_ok & jnp.all(jnp.isfinite(beta), axis=1)
+    return beta, fit_ok
+
+
+fit_leaves = track_jit("linear/fit_leaves", jax.jit(fit_leaves_impl))
+
+
+def _device_raw(ds) -> jax.Array:
+    """Device-resident raw numeric matrix, uploaded once per dataset (the
+    resident-planes pattern applied to the linear fit input)."""
+    arr = getattr(ds, "_device_raw_numeric", None)
+    if arr is None:
+        arr = ds._device_raw_numeric = jnp.asarray(ds.raw_numeric,
+                                                   jnp.float32)
+    return arr
+
+
+def fit_linear_leaves(tree, ds, row_leaf, ghc, *, lam: float, rate: float,
+                      num_leaves_cap: int) -> None:
+    """Device counterpart of the ``_fit_linear_tree`` per-leaf loop:
+    prepares the feature tables on host, runs the batched fit, and writes
+    the surviving leaves' ``leaf_features``/``leaf_coeff``/``leaf_const``
+    back onto the tree in ONE device->host transfer. Leaves the tree's
+    constant outputs untouched wherever the fit declined — identical
+    fallbacks to the oracle."""
+    tables = leaf_feature_table(tree, ds, num_leaves_cap)
+    if tables is None:
+        return
+    feat_idx, feat_mask = tables
+    telemetry.count("linear/device_fits")
+    with telemetry.timed_observe("linear/fit_ms"), \
+            tracer.span("linear/fit", domain="train",
+                        leaves=int(tree.num_leaves)):
+        beta, fit_ok = fit_leaves(
+            _device_raw(ds), row_leaf, ghc[:, 0], ghc[:, 1],
+            jnp.asarray(feat_idx, jnp.int32),
+            jnp.asarray(feat_mask, jnp.bool_),
+            jnp.asarray(lam, jnp.float32))
+        beta_h = np.asarray(beta, np.float64)
+        ok_h = np.asarray(fit_ok)
+    solved = 0
+    for l in range(tree.num_leaves):
+        if not ok_h[l]:
+            continue
+        m = feat_mask[l]
+        coefs = beta_h[l, :-1][m]
+        keep = np.abs(coefs) > 1e-35
+        tree.leaf_features[l] = feat_idx[l, m].astype(np.int64)[keep]
+        tree.leaf_coeff[l] = coefs[keep] * rate
+        tree.leaf_const[l] = float(beta_h[l, -1]) * rate
+        solved += 1
+    telemetry.count("linear/leaves_solved", solved)
+    attempted = int(feat_mask[:tree.num_leaves].any(axis=1).sum())
+    telemetry.count("linear/solve_fallback", attempted - solved)

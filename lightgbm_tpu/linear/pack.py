@@ -1,0 +1,106 @@
+"""Slot-ordered per-leaf coefficient tables for device linear predict.
+
+``ops.predict`` routes rows to leaf SLOTS (``Tree.to_split_arrays``
+order); linear prediction then needs the slot's constant term, feature
+indices and coefficients. The tables here pad every tree of a pack to the
+ensemble's max leaf-feature count so one program shape serves the whole
+model; non-linear trees ride along with ``const == value`` and an
+all-false mask, which evaluates to exactly the plain leaf output.
+"""
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def linear_pack_arrays(trees: List, arrs: List[dict],
+                       value_of_slot: np.ndarray
+                       ) -> Tuple[np.ndarray, np.ndarray, np.ndarray,
+                                  np.ndarray, bool]:
+    """(const_of_slot (T, L) f32, coeff (T, L, km) f32, coeff_feat
+    (T, L, km) i32, coeff_mask (T, L, km) bool, has_linear) for the packed
+    trees; ``arrs`` are their ``to_split_arrays`` dicts (for the
+    slot -> leaf mapping) and ``value_of_slot`` the already-built constant
+    table that non-linear slots inherit."""
+    T, L = value_of_slot.shape
+    has_linear = any(getattr(t, "is_linear", False) for t in trees)
+    km = 1
+    for t in trees:
+        if getattr(t, "is_linear", False):
+            for feats in t.leaf_features.values():
+                km = max(km, len(feats))
+    const_of_slot = value_of_slot.astype(np.float32).copy()
+    coeff = np.zeros((T, L, km), np.float32)
+    coeff_feat = np.zeros((T, L, km), np.int32)
+    coeff_mask = np.zeros((T, L, km), bool)
+    if not has_linear:
+        return const_of_slot, coeff, coeff_feat, coeff_mask, False
+    for ti, (t, a) in enumerate(zip(trees, arrs)):
+        if not getattr(t, "is_linear", False):
+            continue
+        leaf_of_slot = a["leaf_of_slot"]
+        n_slots = len(a["slot"]) + 1 if t.num_leaves > 1 else 1
+        for s in range(n_slots):
+            leaf = int(leaf_of_slot[s]) if t.num_leaves > 1 else 0
+            const_of_slot[ti, s] = t.leaf_const[leaf]
+            feats = t.leaf_features.get(leaf)
+            if feats is None or len(feats) == 0:
+                continue
+            k = len(feats)
+            coeff_feat[ti, s, :k] = feats
+            coeff[ti, s, :k] = t.leaf_coeff[leaf]
+            coeff_mask[ti, s, :k] = True
+    return const_of_slot, coeff, coeff_feat, coeff_mask, True
+
+
+def linear_values_by_row(X: jax.Array, slots: jax.Array, tp,
+                         num_leaves: int, chunk: int = 65536) -> jax.Array:
+    """Per-row linear-leaf outputs for one packed tree: slot one-hot
+    contractions gather const/coeff/feature tables (the
+    ``leaf_values_by_row`` pattern — no per-row element gathers on the
+    small tables), then one feature gather + dot evaluates the models.
+    Rows with NaN in any of their leaf's features fall back to the plain
+    leaf value, exactly as ``Tree.linear_predict`` on host."""
+    n = slots.shape[0]
+    f32 = jnp.float32
+    iota = jnp.arange(num_leaves, dtype=slots.dtype)
+    value = tp.value_of_slot.astype(f32)[:, None]
+    const = tp.const_of_slot.astype(f32)[:, None]
+    # feature indices round-trip exactly through a 0/1 f32 contraction
+    # (column indices are far below 2^24)
+    featf = tp.coeff_feat.astype(f32)
+    maskf = tp.coeff_mask.astype(f32)
+    Xf = X.astype(f32)
+
+    def dot(a, b):
+        return jax.lax.dot(a, b, precision=jax.lax.Precision.HIGHEST,
+                           preferred_element_type=f32)
+
+    def one(xs):
+        s_c, X_c = xs
+        oh = (s_c[:, None] == iota[None, :]).astype(f32)   # (C, L)
+        base = dot(oh, value)[:, 0]
+        cst = dot(oh, const)[:, 0]
+        cf = dot(oh, tp.coeff)                             # (C, km)
+        fi = dot(oh, featf).astype(jnp.int32)
+        cm = dot(oh, maskf) > f32(0.5)
+        z = jnp.take_along_axis(X_c, fi, axis=1)
+        nan = jnp.isnan(z)
+        nanrow = jnp.any(nan & cm, axis=1)
+        zz = jnp.where(cm & jnp.logical_not(nan), z, f32(0))
+        contrib = jnp.sum(zz * cf, axis=1)
+        return jnp.where(nanrow, base, cst + contrib)
+
+    if n <= chunk:
+        # serving buckets sit at or under one chunk — no padding there
+        return one((slots, Xf))
+    pad = (-n) % chunk
+    if pad:
+        slots = jnp.pad(slots, (0, pad))
+        Xf = jnp.pad(Xf, ((0, pad), (0, 0)))
+    out = jax.lax.map(one, (slots.reshape(-1, chunk),
+                            Xf.reshape(-1, chunk, Xf.shape[1])))
+    return out.reshape(-1)[:n]

@@ -22,6 +22,10 @@ _DTYPE_POS = {"zeros": 1, "ones": 1, "empty": 1, "full": 2, "arange": 3,
               "asarray": 1}
 _JNP_HEADS = {"jax.numpy", "jnp"}
 
+#: kernel directories both dtype rules police (linear/ holds the batched
+#: leaf-solve and coefficient-table kernels — same MXU discipline as ops/)
+_KERNEL_DIRS = ("lightgbm_tpu/ops/", "lightgbm_tpu/linear/")
+
 
 @register
 class ImplicitDtypeRule(Rule):
@@ -34,7 +38,7 @@ class ImplicitDtypeRule(Rule):
                    "explicit dtype in lightgbm_tpu/ops/ kernels")
 
     def check_file(self, f: SourceFile) -> Iterator[Finding]:
-        if not f.rel.startswith("lightgbm_tpu/ops/"):
+        if not f.rel.startswith(_KERNEL_DIRS):
             return
         aliases = import_aliases_cached(f)
         for node in f.walk_nodes():
@@ -104,7 +108,7 @@ class DtypePromotionRule(Rule):
                    "int64 indexing in lightgbm_tpu/ops/ kernels")
 
     def check_file(self, f: SourceFile) -> Iterator[Finding]:
-        if not f.rel.startswith("lightgbm_tpu/ops/"):
+        if not f.rel.startswith(_KERNEL_DIRS):
             return
         aliases = import_aliases_cached(f)
         # module-level declared constants participate

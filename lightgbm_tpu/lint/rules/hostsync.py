@@ -16,7 +16,8 @@ from ..graph import graph_for
 #: the traced hot phases: learner/fused drive the per-split loops, ops/
 #: holds the kernels, serve/ the resident inference path
 HOT_FILES = ("lightgbm_tpu/learner.py", "lightgbm_tpu/fused.py")
-HOT_DIRS = ("lightgbm_tpu/ops/", "lightgbm_tpu/serve/")
+HOT_DIRS = ("lightgbm_tpu/ops/", "lightgbm_tpu/serve/",
+            "lightgbm_tpu/linear/")
 
 _SYNC_ATTR_CALLS = {"item", "tolist", "block_until_ready"}
 _SYNC_DOTTED = {"numpy.asarray", "numpy.array", "numpy.ascontiguousarray",

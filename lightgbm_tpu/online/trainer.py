@@ -57,12 +57,14 @@ class _CandidateBuilder:
 
     def __init__(self, mode: str, model_str: str,
                  train_params: Dict[str, Any], continue_rounds: int,
-                 decay_rate: Optional[float]) -> None:
+                 decay_rate: Optional[float],
+                 shadow_decay: float = 1.0) -> None:
         self._mode = mode
         self._src = model_str
         self._params = dict(train_params)
         self._rounds = int(continue_rounds)
         self._decay = decay_rate
+        self._shadow_decay = float(shadow_decay)
 
     def build(self, X: np.ndarray, y: np.ndarray):
         """Train the candidate: leaf re-estimation on the frozen
@@ -89,24 +91,34 @@ class _CandidateBuilder:
         scoring never contends with live serving dispatches."""
         from ..basic import Booster
         incumbent = Booster(model_str=self._src)
-        return self._loss(incumbent, X, y), self._loss(candidate, X, y)
+        w = None
+        if self._shadow_decay < 1.0:
+            # shadow rows arrive oldest -> newest (TrafficBuffer.shadow):
+            # the newest row carries weight 1 and every step back decays,
+            # so live drift dominates the promotion verdict
+            w = self._shadow_decay ** np.arange(len(y) - 1, -1, -1,
+                                                dtype=np.float64)
+        return self._loss(incumbent, X, y, w), self._loss(candidate, X, y, w)
 
-    def _loss(self, model, X: np.ndarray, y: np.ndarray) -> float:
-        """Objective-matched mean loss: logloss for binary, multi-logloss
-        for multiclass, MSE otherwise (predictions come back transformed,
-        so probabilities are directly comparable)."""
+    def _loss(self, model, X: np.ndarray, y: np.ndarray,
+              w: Optional[np.ndarray] = None) -> float:
+        """Objective-matched (weighted) mean loss: logloss for binary,
+        multi-logloss for multiclass, MSE otherwise (predictions come back
+        transformed, so probabilities are directly comparable)."""
         pred = np.asarray(model.predict(X), np.float64)
         obj = getattr(model.inner.objective, "name", "") \
             if model.inner.objective is not None else ""
         n = len(y)
         if obj == "binary":
             p = np.clip(pred.ravel(), _EPS, 1.0 - _EPS)
-            return float(-np.mean(y * np.log(p) + (1 - y) * np.log(1 - p)))
-        if obj.startswith("multiclass"):
+            per_row = -(y * np.log(p) + (1 - y) * np.log(1 - p))
+        elif obj.startswith("multiclass"):
             p = pred.reshape(n, -1)
             picked = p[np.arange(n), y.astype(np.int64)]
-            return float(-np.mean(np.log(np.clip(picked, _EPS, 1.0))))
-        return float(np.mean((pred.ravel() - y) ** 2))
+            per_row = -np.log(np.clip(picked, _EPS, 1.0))
+        else:
+            per_row = (pred.ravel() - y) ** 2
+        return float(np.average(per_row, weights=w))
 
 
 class OnlineTrainer:
@@ -132,11 +144,15 @@ class OnlineTrainer:
                  continue_rounds: int = 10,
                  continue_params: Optional[Dict[str, Any]] = None,
                  decay_rate: Optional[float] = None,
+                 shadow_decay: float = 1.0,
                  candidate_factory=None,
                  start: bool = True) -> None:
         if mode not in MODES:
             raise LightGBMError("online mode must be one of %s, got %r"
                                 % ("|".join(MODES), mode))
+        if not 0.0 < float(shadow_decay) <= 1.0:
+            raise LightGBMError("online shadow_decay must be in (0, 1], "
+                                "got %g" % shadow_decay)
         if not hasattr(booster, "refit") or not hasattr(booster, "inner"):
             raise LightGBMError(
                 "OnlineTrainer needs a lightgbm_tpu.Booster (refit and "
@@ -153,6 +169,7 @@ class OnlineTrainer:
         self._threshold = float(promote_threshold)
         self._continue_rounds = int(continue_rounds)
         self._decay = decay_rate
+        self._shadow_decay = float(shadow_decay)
         # test/extension hook: a callable (X, y) -> Booster replaces the
         # default candidate build (degraded-candidate gate tests)
         self._candidate_factory = candidate_factory
@@ -284,7 +301,8 @@ class OnlineTrainer:
                 src = self._model_str
             builder = _CandidateBuilder(self._mode, src,
                                         self._train_params,
-                                        self._continue_rounds, self._decay)
+                                        self._continue_rounds, self._decay,
+                                        self._shadow_decay)
             with telemetry.timed_observe("online/train_ms"), \
                     tracer.span("online/train", domain="online"):
                 candidate = (self._candidate_factory(X, y)
@@ -359,6 +377,7 @@ class OnlineTrainer:
                 if self._thread is not None else False,
                 "mode": self._mode,
                 "trigger_rows": self._trigger_rows,
+                "shadow_decay": self._shadow_decay,
                 "trains": self._trains,
                 "promotions": self._promotions,
                 "rejections": self._rejections,

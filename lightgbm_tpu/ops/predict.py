@@ -33,7 +33,8 @@ K_ZERO = 1e-35
 
 
 class PackedSplits(NamedTuple):
-    """(T trees, R max splits, L max leaves, Kc max categories)"""
+    """(T trees, R max splits, L max leaves, Kc max categories, Km max
+    linear leaf features)"""
     slot: jax.Array          # (T, R) i32
     feature: jax.Array       # (T, R) i32 column index into X
     threshold: jax.Array     # (T, R) f32
@@ -44,10 +45,18 @@ class PackedSplits(NamedTuple):
     value_of_slot: jax.Array  # (T, L) f32 leaf outputs by slot
     tree_class: jax.Array    # (T,) i32
     cat_values: jax.Array    # (T, R, Kc) i32, padded with -2 (never matches)
+    # linear-leaf tables (lightgbm_tpu/linear/pack.py): non-linear trees
+    # carry const == value and an all-false mask, which evaluates to the
+    # plain leaf output — one program shape serves mixed ensembles
+    const_of_slot: jax.Array  # (T, L) f32 linear constant terms by slot
+    coeff: jax.Array          # (T, L, Km) f32 leaf coefficients
+    coeff_feat: jax.Array     # (T, L, Km) i32 column index into X
+    coeff_mask: jax.Array     # (T, L, Km) bool valid coefficient slots
 
 
 def pack_splits(trees: List, num_class: int = 1) -> PackedSplits:
-    """Pack host Tree models into device arrays (raw-value routing)."""
+    """Pack host Tree models into device arrays (raw-value routing).
+    Returns ``(pack, has_cat, has_linear)``."""
     T = max(len(trees), 1)
     arrs = [t.to_split_arrays() for t in trees] or \
         [dict(slot=np.zeros(0, np.int32), feature=np.zeros(0, np.int32),
@@ -87,6 +96,9 @@ def pack_splits(trees: List, num_class: int = 1) -> PackedSplits:
         value_of_slot[ti, :len(lv)] = lv
         for rr, cats in a["cat_values"].items():
             cat_values[ti, rr, :len(cats)] = cats
+    from ..linear.pack import linear_pack_arrays
+    const_of_slot, coeff, coeff_feat, coeff_mask, has_linear = \
+        linear_pack_arrays(trees, arrs, value_of_slot)
     pk = PackedSplits(
         slot=jnp.asarray(slot, jnp.int32),
         feature=jnp.asarray(feature, jnp.int32),
@@ -97,8 +109,12 @@ def pack_splits(trees: List, num_class: int = 1) -> PackedSplits:
         num_splits=jnp.asarray(num_splits, jnp.int32),
         value_of_slot=jnp.asarray(value_of_slot, jnp.float32),
         tree_class=jnp.asarray(tree_class, jnp.int32),
-        cat_values=jnp.asarray(cat_values, jnp.int32))
-    return pk, has_cat
+        cat_values=jnp.asarray(cat_values, jnp.int32),
+        const_of_slot=jnp.asarray(const_of_slot, jnp.float32),
+        coeff=jnp.asarray(coeff, jnp.float32),
+        coeff_feat=jnp.asarray(coeff_feat, jnp.int32),
+        coeff_mask=jnp.asarray(coeff_mask, jnp.bool_))
+    return pk, has_cat, has_linear
 
 
 def _route_tree(X, tp, has_cat: bool):
@@ -127,8 +143,8 @@ def _route_tree(X, tp, has_cat: bool):
 
 
 def predict_raw_impl(X: jax.Array, pack: PackedSplits, *, num_class: int = 1,
-                     has_cat: bool = False, tree_batch: int = 8,
-                     init_score=None) -> jax.Array:
+                     has_cat: bool = False, has_linear: bool = False,
+                     tree_batch: int = 8, init_score=None) -> jax.Array:
     """(N, F) raw rows -> (N,) or (N, K) raw ensemble scores.
 
     Un-jitted body shared by the training-path ``predict_raw`` below and
@@ -136,6 +152,7 @@ def predict_raw_impl(X: jax.Array, pack: PackedSplits, *, num_class: int = 1,
     it with their own ``jax.jit`` + ``track_jit`` label so compile counts
     stay attributable per entry point."""
     from ..learner import leaf_values_by_row
+    from ..linear.pack import linear_values_by_row
 
     n = X.shape[0]
     X = X.astype(jnp.float32)
@@ -151,8 +168,13 @@ def predict_raw_impl(X: jax.Array, pack: PackedSplits, *, num_class: int = 1,
 
     def one_batch(score, tb):
         slots = jax.vmap(lambda tp: _route_tree(X, tp, has_cat))(tb)  # (tb, N)
-        vals = jax.vmap(lambda lv, s: leaf_values_by_row(lv, s, num_l))(
-            tb.value_of_slot, slots)                                  # (tb, N)
+        if has_linear:
+            vals = jax.vmap(
+                lambda tp, s: linear_values_by_row(X, s, tp, num_l))(
+                    tb, slots)                                        # (tb, N)
+        else:
+            vals = jax.vmap(lambda lv, s: leaf_values_by_row(lv, s, num_l))(
+                tb.value_of_slot, slots)                              # (tb, N)
         # unsplit and padding trees both carry all-zero slot values
         if num_class > 1:
             cls_oh = (tb.tree_class[:, None]
@@ -172,7 +194,8 @@ def predict_raw_impl(X: jax.Array, pack: PackedSplits, *, num_class: int = 1,
 
 
 predict_raw = track_jit("ops/predict_raw", jax.jit(
-    predict_raw_impl, static_argnames=("num_class", "has_cat", "tree_batch")))
+    predict_raw_impl,
+    static_argnames=("num_class", "has_cat", "has_linear", "tree_batch")))
 
 
 def tree_to_bin_log(tree, dataset):

@@ -40,7 +40,8 @@ DEFAULT_BUCKETS = (256, 1024, 4096, 16384, 65536)
 # (or a session recreated after restart-free model reloads) hit the same
 # compiled executables
 _predict_bucket = track_jit("serve/predict_bucket", jax.jit(
-    predict_raw_impl, static_argnames=("num_class", "has_cat", "tree_batch")))
+    predict_raw_impl,
+    static_argnames=("num_class", "has_cat", "has_linear", "tree_batch")))
 
 
 class PredictSession:
@@ -67,6 +68,7 @@ class PredictSession:
         self._lock = threading.Lock()
         self._pack = None
         self._has_cat = False
+        self._has_linear = False
         self._K = max(1, int(self._gbdt.num_tree_per_iteration))
         self._version = -1
         self._range = (0, 0)
@@ -104,7 +106,8 @@ class PredictSession:
 
     def _ensure_pack(self):
         """Refresh the device-resident pack iff the model version (or the
-        resolved iteration range) moved; returns (pack, has_cat)."""
+        resolved iteration range) moved; returns (pack, has_cat,
+        has_linear)."""
         g = self._gbdt
         # lock order is session -> booster (nothing takes them the other
         # way round). Holding the booster's model lock across the
@@ -116,16 +119,12 @@ class PredictSession:
             rng = self._resolve_range()
             if self._pack is None or ver != self._version \
                     or rng != self._range:
-                models = g.models[rng[0] * self._K:rng[1] * self._K]
-                if any(getattr(t, "is_linear", False) for t in models):
-                    raise LightGBMError(
-                        "PredictSession does not support linear trees; use "
-                        "Booster.predict (host path)")
-                self._pack, self._has_cat = g._packed_model(*rng)
+                self._pack, self._has_cat, self._has_linear = \
+                    g._packed_model(*rng)
                 self._version, self._range = ver, rng
                 # pack shapes may have changed -> compiled rungs are stale
                 self._warm.clear()
-            return self._pack, self._has_cat
+            return self._pack, self._has_cat, self._has_linear
 
     def version(self) -> int:
         """Model-version token of the currently-resident pack (-1 before
@@ -142,7 +141,7 @@ class PredictSession:
         on the hot path."""
         import hashlib
 
-        pack, _ = self._ensure_pack()
+        pack, _, _ = self._ensure_pack()
         h = hashlib.sha256()
         for leaf in jax.tree_util.tree_leaves(pack):
             arr = np.asarray(leaf)  # graftlint: disable=host-sync
@@ -159,7 +158,7 @@ class PredictSession:
         MicroBatcher) pull results when delivering them. N beyond the top
         rung is chunked; each chunk pads up to its covering bucket.
         """
-        pack, has_cat = self._ensure_pack()
+        pack, has_cat, has_linear = self._ensure_pack()
         X = np.ascontiguousarray(np.asarray(X), dtype=np.float32)
         if X.ndim == 1:
             X = X[None, :]
@@ -189,7 +188,8 @@ class PredictSession:
                     chunk = np.concatenate(
                         [chunk, np.zeros((b - rows, nf), np.float32)])
                 score = _predict_bucket(jnp.asarray(chunk), pack,
-                                        num_class=self._K, has_cat=has_cat)
+                                        num_class=self._K, has_cat=has_cat,
+                                        has_linear=has_linear)
                 pieces.append((score, rows))
         return pieces
 
@@ -272,8 +272,24 @@ class PredictSession:
         telemetry.count("serve/rows", n)
         telemetry.count("serve/binned_requests")
         ts = ScoreTracker(n, K, np.zeros(K, np.float64))
+        linear_extra = None
         for i, tree in enumerate(g.models[start * K:end * K]):
             vals, leaf = g._route_tree_device(tree, binned)
+            if getattr(tree, "is_linear", False) \
+                    and binned.raw_numeric is not None:
+                # linear leaves need raw feature values; the router
+                # returns to_split_arrays SLOTS — map to LEAF ids for the
+                # coefficient lookup (boosting._linear_score_updates)
+                leaf_of_slot = tree.to_split_arrays()["leaf_of_slot"]
+                rv = tree.linear_predict(
+                    binned.raw_numeric.astype(np.float64),
+                    leaf_of_slot[np.asarray(leaf)])  # graftlint: disable=host-sync
+                if linear_extra is None:
+                    linear_extra = np.zeros((n, K), np.float64)
+                linear_extra[:, i % K] += rv
+                continue
             ts.add(vals, leaf, i % K, K)
         raw = np.asarray(ts.np(), np.float64).reshape(n, -1)
+        if linear_extra is not None:
+            raw = raw + linear_extra
         return self.finalize(raw, raw_score=raw_score)

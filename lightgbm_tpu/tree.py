@@ -177,18 +177,42 @@ class Tree:
 
         Decision semantics mirror _decide: None/Zero missing treats NaN as
         0.0 (Zero additionally routes |x|<=1e-35 to the default side);
-        NaN-aware splits route NaN to the default side.
+        NaN-aware splits route NaN to the default side. Linear leaves emit
+        their const + coeffs . x model guarded by the NaN fallback to the
+        plain leaf value (linear_predict semantics).
         """
         lines = ["double PredictTree%d(const double* arr) {" % index]
         if self.num_leaves <= 1:
-            lines.append("  return %.17g;" % float(self.leaf_value[0]))
+            const0 = self.leaf_const[0] if self.is_linear \
+                else self.leaf_value[0]
+            lines.append("  return %.17g;" % float(const0))
             lines.append("}")
             return "\n".join(lines)
 
+        def emit_leaf(leaf: int, ind: str, out):
+            if not self.is_linear:
+                out.append("%sreturn %.17g;"
+                           % (ind, float(self.leaf_value[leaf])))
+                return
+            feats = self.leaf_features.get(leaf)
+            if feats is None or len(feats) == 0:
+                out.append("%sreturn %.17g;"
+                           % (ind, float(self.leaf_const[leaf])))
+                return
+            # any NaN among the leaf's features -> plain leaf value
+            nan_check = " || ".join("std::isnan(arr[%d])" % int(f)
+                                    for f in feats)
+            terms = " + ".join(
+                "%.17g * arr[%d]" % (float(c), int(f))
+                for f, c in zip(feats, self.leaf_coeff[leaf]))
+            out.append("%sif (%s) return %.17g;"
+                       % (ind, nan_check, float(self.leaf_value[leaf])))
+            out.append("%sreturn %.17g + %s;"
+                       % (ind, float(self.leaf_const[leaf]), terms))
+
         def emit(node: int, ind: str, out):
             if node < 0:
-                out.append("%sreturn %.17g;"
-                           % (ind, float(self.leaf_value[~node])))
+                emit_leaf(~node, ind, out)
                 return
             f = int(self.split_feature[node])
             dt = int(self.decision_type[node])

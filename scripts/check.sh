@@ -24,10 +24,11 @@ echo "== lint tests =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_lint.py -q -m 'not slow'
 
 if [ "$RUN_SUBSET" = 1 ]; then
-    echo "== serve/online/obs fast tests =="
+    echo "== serve/online/obs/linear fast tests =="
     JAX_PLATFORMS=cpu python -m pytest -q -m 'not slow' \
         tests/test_serve.py tests/test_online.py \
-        tests/test_obs.py tests/test_trace.py
+        tests/test_obs.py tests/test_trace.py \
+        tests/test_linear_device.py
 fi
 
 if [ "$RUN_SLO" = 1 ]; then

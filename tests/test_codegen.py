@@ -82,6 +82,29 @@ def test_multiclass_codegen_exact(tmp_path):
 
 
 @needs_gxx
+def test_linear_codegen_exact(tmp_path):
+    """convert_model used to Log.fatal on linear trees; the generated C++
+    now emits the per-leaf linear terms (with the NaN constant fallback)
+    and must round-trip exactly against the f64 host predict."""
+    rng = np.random.RandomState(3)
+    n = 1500
+    X = rng.normal(size=(n, 4))
+    y = 0.3 * X[:, 0] - 0.1 * X[:, 1] + 0.02 * rng.normal(size=n)
+    X[rng.rand(n) < 0.1, 0] = np.nan          # exercise the NaN fallback
+    p = {"objective": "regression", "num_leaves": 8, "verbose": -1,
+         "linear_tree": True, "linear_lambda": 0.01}
+    bst = lgb.train(p, lgb.Dataset(X, label=y, params=dict(p)),
+                    num_boost_round=5)
+    assert any(t.is_linear for t in bst.inner.models)
+    src = tmp_path / "model.cpp"
+    src.write_text(bst.inner.to_if_else_cpp())
+    lib = _compile(str(src), str(tmp_path))
+    raw = _predict_all(lib, X[:300], 1, raw=True)[:, 0]
+    np.testing.assert_allclose(raw, bst.predict(X[:300], raw_score=True),
+                               rtol=0, atol=1e-10)
+
+
+@needs_gxx
 def test_categorical_codegen_exact(tmp_path):
     rng = np.random.RandomState(2)
     n = 1500

@@ -1,0 +1,269 @@
+"""Device linear-leaf fit/predict vs the host oracle (ISSUE 9 tentpole).
+
+``linear_device=on`` routes the per-leaf ridge solves through the batched
+device kernel (lightgbm_tpu/linear/fit.py: one segment-sum of outer
+products + one batched jnp.linalg.solve for ALL leaves); ``off`` keeps
+the sequential host/numpy path. Both must produce the same model: these
+tests pin coefficient AND prediction parity at atol=1e-6, the NaN
+fallback, multiclass, categorical splits, and the serving path
+(PredictSession used to refuse linear models outright).
+
+Numerics note: the device path accumulates and solves in f32 (HIGHEST
+precision matmuls), the host oracle in f64. The parity bar is met on
+well-conditioned data; the fixtures keep coefficients O(0.3) so the f32
+accumulation error stays under the absolute tolerance.
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import obs
+
+ATOL = 1e-6
+
+
+def _params(**kw):
+    p = {"objective": "regression", "num_leaves": 8, "verbosity": -1,
+         "linear_tree": True, "linear_lambda": 0.01, "learning_rate": 0.2,
+         "min_data_in_leaf": 20, "seed": 7}
+    p.update(kw)
+    return p
+
+
+def _train_pair(X, y, rounds=3, **kw):
+    """Same data/config trained with the host oracle and the device path."""
+    out = []
+    for dev in ("off", "on"):
+        p = _params(linear_device=dev, **kw)
+        out.append(lgb.train(p, lgb.Dataset(X, label=y, params=dict(p)),
+                             num_boost_round=rounds))
+    return out
+
+
+def _assert_model_parity(host, device, atol=ATOL):
+    assert len(host.inner.models) == len(device.inner.models)
+    fitted = 0
+    for i, (th, td) in enumerate(zip(host.inner.models, device.inner.models)):
+        assert th.is_linear == td.is_linear, i
+        assert sorted(th.leaf_coeff) == sorted(td.leaf_coeff), i
+        for leaf in th.leaf_coeff:
+            assert np.array_equal(th.leaf_features[leaf],
+                                  td.leaf_features[leaf]), (i, leaf)
+            np.testing.assert_allclose(
+                np.asarray(td.leaf_coeff[leaf], np.float64),
+                np.asarray(th.leaf_coeff[leaf], np.float64),
+                rtol=0, atol=atol, err_msg="tree %d leaf %d coeff" % (i, leaf))
+            np.testing.assert_allclose(
+                td.leaf_const[leaf], th.leaf_const[leaf],
+                rtol=0, atol=atol, err_msg="tree %d leaf %d const" % (i, leaf))
+            fitted += len(th.leaf_coeff[leaf]) > 0
+    return fitted
+
+
+def test_device_fit_coefficient_and_prediction_parity(rng):
+    n = 2000
+    X = rng.randn(n, 6)
+    y = 0.3 * X[:, 0] - 0.15 * X[:, 1] + 0.02 * rng.randn(n)
+    host, device = _train_pair(X, y)
+    assert _assert_model_parity(host, device) > 0
+    np.testing.assert_allclose(device.predict(X), host.predict(X),
+                               rtol=0, atol=ATOL)
+
+
+def test_device_fit_nan_rows_parity(rng):
+    """NaN rows drop out of the normal equations on both sides; leaves
+    that lose too many rows fall back to the constant leaf value."""
+    n = 2000
+    X = rng.randn(n, 4)
+    y = 0.3 * X[:, 0] + 0.1 * X[:, 2] + 0.02 * rng.randn(n)
+    X[rng.rand(n) < 0.15, 0] = np.nan
+    X[rng.rand(n) < 0.05, 2] = np.nan
+    host, device = _train_pair(X, y)
+    _assert_model_parity(host, device)
+    ph, pd = host.predict(X), device.predict(X)
+    assert np.isfinite(pd).all()
+    np.testing.assert_allclose(pd, ph, rtol=0, atol=ATOL)
+
+
+def test_device_fit_multiclass_parity(rng):
+    n = 1500
+    X = rng.randn(n, 5)
+    y = ((X[:, 0] + 0.5 * X[:, 1] > 0).astype(int)
+         + (X[:, 2] > 0.5).astype(int))
+    host, device = _train_pair(
+        X, y, rounds=2, objective="multiclass", num_class=3, num_leaves=6)
+    assert _assert_model_parity(host, device) > 0
+    np.testing.assert_allclose(device.predict(X), host.predict(X),
+                               rtol=0, atol=ATOL)
+
+
+def test_device_fit_categorical_parity(rng):
+    """Categorical features split but never enter the per-leaf design
+    matrix — the device feature tables must apply the same filter."""
+    n = 1500
+    X = rng.randn(n, 5)
+    X[:, 4] = rng.randint(0, 8, size=n)
+    y = (0.3 * X[:, 0] + 0.1 * (X[:, 4] % 3) + 0.02 * rng.randn(n))
+    host, device = _train_pair(X, y, categorical_feature=[4])
+    fitted = _assert_model_parity(host, device)
+    assert fitted > 0
+    for t in device.inner.models:
+        for leaf, feats in t.leaf_features.items():
+            assert 4 not in feats, (leaf, feats)
+    np.testing.assert_allclose(device.predict(X), host.predict(X),
+                               rtol=0, atol=ATOL)
+
+
+def test_linear_device_auto_is_host_on_cpu(rng):
+    """auto only takes the device path on a real TPU backend; on the CPU
+    suite it must be bit-identical to the host oracle."""
+    import jax
+    if jax.default_backend() == "tpu":
+        pytest.skip("auto resolves to the device path on TPU")
+    n = 1200
+    X = rng.randn(n, 4)
+    y = 0.3 * X[:, 0] + 0.02 * rng.randn(n)
+    p_auto = _params(linear_device="auto")
+    p_off = _params(linear_device="off")
+    b_auto = lgb.train(p_auto, lgb.Dataset(X, label=y, params=dict(p_auto)),
+                       num_boost_round=3)
+    b_off = lgb.train(p_off, lgb.Dataset(X, label=y, params=dict(p_off)),
+                      num_boost_round=3)
+    for ta, to in zip(b_auto.inner.models, b_off.inner.models):
+        assert sorted(ta.leaf_coeff) == sorted(to.leaf_coeff)
+        for leaf in ta.leaf_coeff:
+            assert np.array_equal(ta.leaf_coeff[leaf], to.leaf_coeff[leaf])
+            assert ta.leaf_const[leaf] == to.leaf_const[leaf]
+
+
+def test_device_fit_telemetry_counters(rng):
+    n = 1500
+    X = rng.randn(n, 4)
+    y = 0.3 * X[:, 0] + 0.02 * rng.randn(n)
+    p = _params(linear_device="on")
+    obs.telemetry.reset()
+    bst = lgb.train(p, lgb.Dataset(X, label=y, params=dict(p)),
+                    num_boost_round=3)
+    # first iteration never fits linear leaves -> 2 device fits
+    assert obs.telemetry.counter("linear/device_fits") == 2
+    solved = obs.telemetry.counter("linear/leaves_solved")
+    assert solved > 0
+    fitted = sum(len(c) > 0 for t in bst.inner.models
+                 for c in t.leaf_coeff.values())
+    assert solved == fitted
+    assert obs.telemetry.counter("linear/solve_fallback") >= 0
+
+
+def test_linear_device_param_validated():
+    with pytest.raises(Exception):
+        lgb.train(_params(linear_device="gpu"),
+                  lgb.Dataset(np.zeros((50, 2)), label=np.zeros(50)),
+                  num_boost_round=1)
+
+
+# --------------------------------------------------------- device predict
+
+def test_device_predict_matches_host_predict(rng):
+    """The boosting ``has_linear`` host fallback is gone: large-n predict
+    rides the packed device path for linear models and must agree with the
+    small-n host path on the same model."""
+    from lightgbm_tpu.ops.predict import pack_splits
+    n = 2000
+    X = rng.randn(n, 5)
+    y = 0.3 * X[:, 0] - 0.1 * X[:, 1] + 0.02 * rng.randn(n)
+    p = _params(linear_device="off")
+    bst = lgb.train(p, lgb.Dataset(X, label=y, params=dict(p)),
+                    num_boost_round=4)
+    assert any(t.is_linear for t in bst.inner.models)
+    _, _, has_linear = pack_splits(bst.inner.models, num_class=1)
+    assert has_linear
+    small = bst.predict(X[:64])            # below DEVICE_PREDICT_MIN_ROWS
+    large = bst.predict(X)                 # packed device predict
+    np.testing.assert_allclose(large[:64], small, rtol=0, atol=ATOL)
+
+
+def test_device_predict_nan_fallback_rows(rng):
+    n = 2000
+    X = rng.randn(n, 4)
+    y = 0.3 * X[:, 0] + 0.02 * rng.randn(n)
+    p = _params(linear_device="off")
+    bst = lgb.train(p, lgb.Dataset(X, label=y, params=dict(p)),
+                    num_boost_round=3)
+    Xn = X.copy()
+    Xn[::5, 0] = np.nan                    # rows hit the constant fallback
+    small = bst.predict(Xn[:64])
+    large = bst.predict(Xn)
+    assert np.isfinite(large).all()
+    np.testing.assert_allclose(large[:64], small, rtol=0, atol=ATOL)
+
+
+# ----------------------------------------------------------------- serving
+
+def _session_data(rng, n=1500):
+    X = rng.randn(n, 5)
+    y = 0.3 * X[:, 0] - 0.1 * X[:, 1] + 0.02 * rng.randn(n)
+    return X, y
+
+
+def test_session_serves_linear_model(rng):
+    """PredictSession used to refuse linear models; now they ride the
+    bucket ladder with in-run parity against the host predict."""
+    from lightgbm_tpu.serve import PredictSession
+    X, y = _session_data(rng)
+    p = _params(linear_device="off")
+    bst = lgb.train(p, lgb.Dataset(X, label=y, params=dict(p)),
+                    num_boost_round=4)
+    sess = PredictSession(bst, buckets=(256,))
+    got = sess.predict(X[:200])
+    want = bst.predict(X[:200])
+    np.testing.assert_allclose(np.asarray(got).ravel(), want,
+                               rtol=0, atol=ATOL)
+    # version-token cache: continued training bumps the model version and
+    # the SAME session must serve the new linear leaves (num_iteration=-1:
+    # Booster.predict otherwise caps at the pre-update best_iteration)
+    bst.update()
+    got2 = sess.predict(X[:200])
+    np.testing.assert_allclose(np.asarray(got2).ravel(),
+                               bst.predict(X[:200], num_iteration=-1),
+                               rtol=0, atol=ATOL)
+
+
+def test_session_linear_nan_rows(rng):
+    from lightgbm_tpu.serve import PredictSession
+    X, y = _session_data(rng)
+    p = _params(linear_device="off")
+    bst = lgb.train(p, lgb.Dataset(X, label=y, params=dict(p)),
+                    num_boost_round=3)
+    Xn = X[:128].copy()
+    Xn[::4, 0] = np.nan
+    sess = PredictSession(bst, buckets=(256,))
+    np.testing.assert_allclose(np.asarray(sess.predict(Xn)).ravel(),
+                               bst.predict(Xn), rtol=0, atol=ATOL)
+
+
+def test_http_serves_linear_model(rng):
+    import json
+    import threading
+    from urllib.request import Request, urlopen
+
+    from lightgbm_tpu.serve.http import PredictServer
+    X, y = _session_data(rng)
+    p = _params(linear_device="off")
+    bst = lgb.train(p, lgb.Dataset(X, label=y, params=dict(p)),
+                    num_boost_round=3)
+    server = PredictServer(bst, port=0, buckets=(64,), max_wait_ms=1.0)
+    host, port = server.address
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        body = json.dumps({"rows": X[:8].tolist()}).encode()
+        req = Request("http://%s:%d/predict" % (host, port), data=body,
+                      headers={"Content-Type": "application/json"})
+        with urlopen(req, timeout=30) as resp:
+            out = json.loads(resp.read())
+        np.testing.assert_allclose(np.asarray(out["predictions"]).ravel(),
+                                   bst.predict(X[:8]), rtol=0, atol=ATOL)
+    finally:
+        server.shutdown()
+        thread.join(timeout=10)
+        server.close()

@@ -169,6 +169,68 @@ def test_shadow_gate_rejects_degraded_candidate_pack_identical():
     assert st["last_losses"]["candidate"] > st["last_losses"]["current"]
 
 
+def test_shadow_decay_weighted_loss_matches_manual():
+    """online_shadow_decay=d weights the shadow window by recency
+    (newest row weight 1, each step back x d); d=1.0 (the default) is
+    bit-identical to the uniform mean it replaces."""
+    from lightgbm_tpu.online.trainer import _CandidateBuilder, _EPS
+    bst = _train(seed=5)
+    src = bst.model_to_string()
+    Xs, ys = _data(50, seed=7)
+    cand = lgb.Booster(model_str=src)
+    p = np.clip(np.asarray(bst.predict(Xs), np.float64), _EPS, 1.0 - _EPS)
+    per_row = -(ys * np.log(p) + (1 - ys) * np.log(1 - p))
+
+    uni = _CandidateBuilder("refit", src, {}, 1, None)
+    cur_u, cand_u = uni.score_pair(cand, Xs, ys)
+    assert cur_u == cand_u                     # same model on both sides
+    assert cur_u == float(np.mean(per_row))    # default: exact uniform mean
+
+    dec = _CandidateBuilder("refit", src, {}, 1, None, shadow_decay=0.9)
+    cur_d, _ = dec.score_pair(cand, Xs, ys)
+    w = 0.9 ** np.arange(len(ys) - 1, -1, -1, dtype=np.float64)
+    np.testing.assert_allclose(cur_d, np.average(per_row, weights=w),
+                               rtol=1e-12)
+    assert cur_d != cur_u
+
+
+def test_shadow_decay_flips_promotion_under_drift():
+    """The point of the decayed window: after a concept flip, the stale
+    majority of the shadow window outvotes the drifted tail under uniform
+    weighting (candidate rejected) while a decayed window follows the
+    live traffic (candidate promoted)."""
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "min_data_in_leaf": 5}
+    X_new, y_new = _data(200, seed=11)
+    y_drift = 1.0 - y_new                      # inverted concept
+    cand_src = lgb.train(params, lgb.Dataset(X_new, label=y_drift),
+                         num_boost_round=6)
+
+    def run(decay):
+        bst = _train(seed=1)                   # incumbent: original concept
+        cand = lgb.Booster(model_str=cand_src.model_to_string())
+        tr = OnlineTrainer(bst, trigger_rows=10_000, min_rows=32,
+                           shadow_rows=1024, shadow_decay=decay,
+                           candidate_factory=lambda X, y: cand, start=False)
+        X_old, y_old = _data(600, seed=12)     # stale majority first...
+        tr.ingest(X_old, y_old)
+        tr.ingest(X_new, y_drift)              # ...drifted tail newest
+        return tr.run_once()
+
+    assert run(1.0) == "rejected"
+    assert run(0.95) == "promoted"
+
+
+def test_shadow_decay_validated_and_surfaced():
+    from lightgbm_tpu.utils.log import LightGBMError
+    bst = _train(seed=6)
+    for bad in (0.0, -0.5, 1.5):
+        with pytest.raises(LightGBMError):
+            OnlineTrainer(bst, shadow_decay=bad, start=False)
+    tr = OnlineTrainer(bst, shadow_decay=0.98, start=False)
+    assert tr.state()["shadow_decay"] == 0.98
+
+
 def test_promote_threshold_zero_rejects_everything():
     bst = _train(seed=2)
     tr = OnlineTrainer(bst, trigger_rows=100, min_rows=32,

@@ -134,6 +134,31 @@ def test_second_same_bucket_predict_zero_compiles():
     assert obs.telemetry.counter("serve/bucket_hit") == 3
 
 
+def test_second_same_shape_linear_predict_zero_compiles():
+    """Linear models ride the same bucket contract: the coefficient-table
+    gather + dot adds no per-call retrace, so a second same-shape predict
+    on a linear model pays ZERO compiles and ZERO re-packs."""
+    from lightgbm_tpu.serve import PredictSession
+    rng = np.random.RandomState(3)
+    X = rng.randn(1000, 5)
+    y = 0.3 * X[:, 0] - 0.1 * X[:, 1] + 0.02 * rng.randn(1000)
+    p = {"objective": "regression", "num_leaves": 8, "verbosity": -1,
+         "linear_tree": True, "linear_lambda": 0.01}
+    bst = lgb.train(p, lgb.Dataset(X, label=y, params=dict(p)),
+                    num_boost_round=4)
+    assert any(t.is_linear for t in bst.inner.models)
+    sess = PredictSession(bst, buckets=(1024,))
+    sess.predict(X[:600])                    # warm: pack upload + compile
+    obs.telemetry.reset()
+    sess.predict(X[:600])                    # same bucket, same N
+    sess.predict(X[:1000])                   # same bucket, different N
+    jc = obs.telemetry.snapshot()["jit_compiles"]
+    assert jc["total"] == 0, jc
+    assert jc["backend_compiles"] == 0, jc
+    assert obs.telemetry.counter("serve/pack_build") == 0
+    assert obs.telemetry.counter("serve/bucket_hit") == 2
+
+
 def test_warmup_ladder_compile_budget():
     """warmup() pre-compiles the ladder: at most one predict compile per
     rung, and a second warmup compiles nothing new."""
