@@ -38,6 +38,9 @@ LINEAR_ITER = int(os.environ.get("BENCH_LINEAR_ITERS", 15))
 # non-empty = record host spans (trace_spans=on) and write the flight
 # recorder as Chrome trace-event JSON (Perfetto-loadable) to this path
 TRACE_PATH = os.environ.get("BENCH_TRACE", "")
+# non-empty = append this bench run to the JSONL run ledger at this path
+# (kind="bench"; scripts/ledger.py queries/gates it)
+LEDGER_PATH = os.environ.get("BENCH_LEDGER", "")
 
 # reference CPU: Higgs 130.094 s / (500 iter * 10.5M rows); MSLR 70.417 s /
 # (500 * 2.27M)  [BASELINE.md, docs/Experiments.rst:109-123]
@@ -74,20 +77,32 @@ def make_mslr_like(n, f=137, docs_per_query=120, seed=11):
 def _phases(timer, wall, traffic=None):
     """Fused-path phase dict for one timed train + its own accounting.
 
-    dispatch = async block launches (host-side trace/launch work),
-    logs_transfer = host blocked on the device + the split-log pull,
-    host_trees = per-tree model reconstruction on host. logs_transfer is
-    where device execution surfaces (the pipeline overlaps transfer of
-    block i with execution of block i+1, so it absorbs device time).
+    Device-time attribution (obs_device PR): each finalize bounds device
+    execution with a forced 1-element transfer (obs.sync) BEFORE pulling
+    the split-log payload, so the old ">90% in logs_transfer" catch-all
+    splits into
+
+      device_s   = fused/device_wait   — host blocked on non-overlapped
+                   device execution (the pipeline overlaps block i's wait
+                   with block i+1's launch, so this is the un-hidden part),
+      transfer_s = fused/logs_transfer — the pure device->host log pull,
+      host_s     = block trace/compile + async dispatch + per-tree model
+                   reconstruction + dataset construction.
+
+    The legacy per-phase keys stay alongside for trend continuity.
 
     traffic, when given, is the learner's deterministic bytes-per-row
     accounting of the per-split hot loop (SerialTreeLearner.traffic_spec) —
     merged AFTER the wall accounting so accounted_pct stays a pure
     wall-time self-check."""
     t = timer.times
-    keys = ("fused/block_fn", "fused/dispatch", "fused/logs_transfer",
-            "fused/host_trees", "dataset construction")
+    host_keys = ("fused/block_fn", "fused/dispatch", "fused/host_trees",
+                 "dataset construction")
+    keys = host_keys + ("fused/device_wait", "fused/logs_transfer")
     out = {k.split("/")[-1]: round(t.get(k, 0.0), 3) for k in keys}
+    out["device_s"] = round(t.get("fused/device_wait", 0.0), 3)
+    out["transfer_s"] = round(t.get("fused/logs_transfer", 0.0), 3)
+    out["host_s"] = round(sum(t.get(k, 0.0) for k in host_keys), 3)
     acc = sum(t.get(k, 0.0) for k in keys)
     out["other"] = round(max(wall - acc, 0.0), 3)
     out["accounted_pct"] = round(100.0 * min(acc / max(wall, 1e-9), 1.0), 1)
@@ -299,6 +314,24 @@ def main():
     # retrace detector verdict, hoisted for headline visibility (PERF.md
     # per-train compile budget; per-entry detail under telemetry)
     result["jit_compiles"] = result["telemetry"]["jit_compiles"]["total"]
+    if LEDGER_PATH:
+        try:
+            from lightgbm_tpu import obs_ledger
+            from lightgbm_tpu.config import Config
+            cfg = Config.from_params({
+                "objective": "binary", "num_leaves": NUM_LEAVES,
+                "max_bin": MAX_BIN, "learning_rate": 0.1, "verbosity": -1,
+                "metric": ["auc"], "tpu_iter_block": 20,
+                "obs_ledger": True, "obs_ledger_path": LEDGER_PATH})
+            obs_ledger.record_run(
+                cfg, "bench", N_ROWS, 28,
+                extra={"train_s": round(h_train, 3),
+                       "throughput_M": result["value"],
+                       "train_breakdown": h_ph})
+            result["ledger_path"] = LEDGER_PATH
+        except Exception as e:  # pragma: no cover - report, don't fail
+            result["ledger_error"] = "%s: %s" % (type(e).__name__,
+                                                 str(e)[:200])
     if TRACE_PATH:
         from lightgbm_tpu.obs_trace import tracer
         result["trace_path"] = TRACE_PATH

@@ -112,6 +112,10 @@ class GBDT:
     # ------------------------------------------------------------------ setup
     def _setup(self, train_set: BinnedDataset) -> None:
         cfg = self.config
+        # device-cost capture is process-global (obs_device mirrors the
+        # trace_spans configure contract: last writer wins)
+        from . import obs_device
+        obs_device.configure(cost_enabled=cfg.obs_device_cost)
         self.objective = create_objective(cfg)
         self.objective.init(train_set.metadata)
         self.num_tree_per_iteration = self.objective.num_model_per_iteration
@@ -295,6 +299,14 @@ class GBDT:
             if self.num_class > 1:
                 g = g.reshape(self.train_set.num_data, self.num_class)
                 h = h.reshape(self.train_set.num_data, self.num_class)
+        if self.config.obs_check_finite != "off":
+            # opt-in watchdog (eager path): one fused isfinite reduction
+            # over this iteration's gradients — a custom fobj or an
+            # exploding objective surfaces here, at the iteration it
+            # happened. Gated BEFORE any array op: off builds nothing.
+            from . import obs_device
+            obs_device.check_finite("grads", (g, h),
+                                    self.config.obs_check_finite)
         self._bagging(it, g, h)
         self._last_grad, self._last_hess = g, h
         fmask = self._feature_mask(it)
@@ -333,6 +345,10 @@ class GBDT:
                                 spec["hist_bytes_per_row"])
             if tree.num_leaves > 1:
                 any_nonconstant = True
+        if self.config.obs_check_finite != "off":
+            from . import obs_device
+            obs_device.check_finite("scores", (self.train_score.score,),
+                                    self.config.obs_check_finite)
         with self._cache_lock:
             self.iter_ += 1
             self._bump_model_version()

@@ -71,6 +71,9 @@ class Application:
         from .obs_trace import tracer
         tracer.configure(self.config.trace_spans,
                          self.config.trace_buffer_events)
+        # same contract for the device-cost capture flag
+        from . import obs_device
+        obs_device.configure(cost_enabled=self.config.obs_device_cost)
 
     def run(self) -> None:
         task = self.config.task
@@ -236,6 +239,13 @@ class Application:
             from .obs_trace import start_periodic_telemetry_dump
             stop_dump = start_periodic_telemetry_dump(
                 cfg.dump_telemetry, cfg.telemetry_dump_interval_s)
+        stop_hbm = None
+        if cfg.obs_hbm_sample_interval_s > 0:
+            # live-HBM watermark under load (hbm/* gauges on /metrics;
+            # counted no-op on backends without memory stats)
+            from . import obs_device
+            stop_hbm = obs_device.start_hbm_sampler(
+                cfg.obs_hbm_sample_interval_s)
         import signal
         import threading
 
@@ -260,11 +270,18 @@ class Application:
         finally:
             if stop_dump is not None:
                 stop_dump.set()
+            if stop_hbm is not None:
+                stop_hbm.set()
             # drains the batchers: requests admitted before the drain
             # flag flipped still get their answers
             server.close()
             if old_term is not None:
                 signal.signal(signal.SIGTERM, old_term)
+            if cfg.obs_ledger:
+                # one serve entry per process lifetime: the serving
+                # latency histograms + device-cost section at drain time
+                from . import obs_ledger
+                obs_ledger.record_run(cfg, "serve", 0, 0)
         Log.info("serve: drained and closed")
 
 

@@ -148,6 +148,29 @@ class Config:
     # and on SIGUSR2 while the task runs)
     dump_trace: str = ""
 
+    # ---- device-cost observability (obs_device / obs_ledger) ----
+    # capture Compiled.cost_analysis()/memory_analysis() per tracked-jit
+    # compile into the telemetry device_cost section. Costs one extra AOT
+    # backend compile per (entry point, signature) AT COMPILE TIME only;
+    # steady-state training/serving pays nothing (the compile-budget
+    # tests pin 0 new compiles on warm runs either way).
+    obs_device_cost: bool = True
+    # training health watchdog: per-block device-side isfinite reduction
+    # over grads/scores. off (default) builds zero device ops; warn logs
+    # and counts obs/nonfinite_*; raise aborts training on the block the
+    # blow-up happened.
+    obs_check_finite: str = "off"   # off | warn | raise
+    # while task=serve runs, sample device.memory_stats() into the
+    # hbm/* gauges every this many seconds (0 = boundary samples only;
+    # CPU backends without memory stats degrade to a counted no-op)
+    obs_hbm_sample_interval_s: float = 0.0
+    # append one JSONL record per train/serve run (config fingerprint,
+    # machine identity, resolved auto knobs, telemetry + device-cost
+    # snapshot) and pre-resolve tpu_* auto knobs from the latest matching
+    # (machine, dataset-shape, config) entry on the next run
+    obs_ledger: bool = False
+    obs_ledger_path: str = "lgbtpu_ledger.jsonl"
+
     # ---- linear tree ----
     linear_tree: bool = False
     linear_lambda: float = 0.0
@@ -418,6 +441,14 @@ class Config:
         if self.telemetry_dump_interval_s < 0:
             Log.fatal("telemetry_dump_interval_s must be >= 0, got %g",
                       self.telemetry_dump_interval_s)
+        if self.obs_check_finite not in ("off", "warn", "raise"):
+            Log.fatal("obs_check_finite must be off, warn or raise; got %s",
+                      self.obs_check_finite)
+        if self.obs_hbm_sample_interval_s < 0:
+            Log.fatal("obs_hbm_sample_interval_s must be >= 0, got %g",
+                      self.obs_hbm_sample_interval_s)
+        if self.obs_ledger and not self.obs_ledger_path:
+            Log.fatal("obs_ledger=true requires a non-empty obs_ledger_path")
         warned = getattr(self, "_noop_warned", None)
         if warned is None:
             warned = set()

@@ -124,6 +124,7 @@ def train(
                         "that meet the split requirements")
         booster.best_iteration = booster.inner.iter_
         booster.inner.best_iteration = booster.best_iteration
+        _ledger_record(booster)
         return booster
 
     snapshot_freq = int(params.get("snapshot_freq", -1))
@@ -169,7 +170,24 @@ def train(
         booster.best_iteration = booster.inner.iter_
     booster.inner.best_iteration = booster.best_iteration
     global_timer.maybe_report()
+    _ledger_record(booster)
     return booster
+
+
+def _ledger_record(booster: Booster) -> None:
+    """Append this train run to the JSONL ledger when ``obs_ledger`` is
+    on. Zero work (one attribute read) when off; never raises — the run
+    it describes already succeeded."""
+    try:
+        cfg = booster.inner.config
+        if not getattr(cfg, "obs_ledger", False):
+            return
+        ds = booster.inner.train_set
+        from . import obs_ledger
+        obs_ledger.record_run(cfg, "train", ds.num_data, ds.num_features,
+                              extra={"iterations": booster.inner.iter_})
+    except Exception as exc:
+        Log.warning("ledger record failed (%s): %s", type(exc).__name__, exc)
 
 
 class CVBooster:

@@ -23,9 +23,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import obs_device
 from .config import Config
 from .learner import SerialTreeLearner, TreeLog, leaf_values_by_row
-from .obs import telemetry, trace_phase, track_jit
+from .obs import sync, telemetry, trace_phase, track_jit
 from .utils.timer import global_timer
 
 # Process-wide cache of jitted block functions. A Booster's jitted callables
@@ -444,6 +445,14 @@ class FusedTrainer:
                                      _obj_array_state(gbdt.objective))
         gbdt.train_score.score = score
         self._cegb_used_dev = used
+        if self.config.obs_check_finite != "off":
+            # opt-in watchdog: one fused isfinite reduction over the
+            # block's output scores. The scalar fetch waits on THIS block,
+            # trading the one-block pipeline overlap for catching a NaN
+            # blow-up at the block it happened (grads are internal to the
+            # scan; a non-finite grad surfaces in the scores it produces).
+            obs_device.check_finite("scores", (score,),
+                                    self.config.obs_check_finite)
         # pre_score/pre_used ride along for the rollback paths below
         self._pending = (logs, k, pre_score, pre_used)
         stopped = self._finalize(prev)
@@ -514,9 +523,23 @@ class FusedTrainer:
         last_iter_constant = False
         trees = []
         try:
+            # Device-time attribution (ADVICE item 4): the old single
+            # logs_transfer block conflated waiting for the device with
+            # pulling the payload, making "transfer" a >90% catch-all in
+            # the bench breakdown. Split per discipline v2: a forced
+            # 1-element transfer (obs.sync — the only trusted completion
+            # barrier) bounds non-overlapped DEVICE time as the host
+            # experiences it; the device_get that follows is then the
+            # pure host<-device payload pull. Pipelining is preserved:
+            # _finalize waits on the PREVIOUS block while the freshly
+            # dispatched one executes.
+            with global_timer.timed("fused/device_wait"), \
+                    trace_phase("lgbtpu/fused_device_wait"):
+                sync(logs)
             with global_timer.timed("fused/logs_transfer"), \
                     trace_phase("lgbtpu/fused_flush"):
                 host = jax.device_get(logs)
+            obs_device.maybe_sample_hbm()   # block-boundary HBM watermark
             with global_timer.timed("fused/host_trees"):
                 for i in range(k):
                     all_constant = True

@@ -1584,14 +1584,52 @@ class SerialTreeLearner:
             forced=self._forced_splits(),
         )
         if self.use_partition():
+            from .obs import telemetry
             mode = config.tpu_hist_precision
             if config.use_quantized_grad:
                 mode = "int8"
             backend = jax.default_backend()
+            # Ledger preresolution (ROADMAP self-calibration): a previous
+            # run on this (machine, dataset-shape, config) key already
+            # resolved the auto knobs; reuse its answers instead of
+            # re-deriving them, recording under ledger_preresolution so
+            # the knob set still persists forward (and the acceptance
+            # test can assert ZERO new auto_resolution records). Values
+            # come from a JSON file: sanitize here, and every validation
+            # gate below still applies to them.
+            pre = {}
+            if config.obs_ledger:
+                from . import obs_ledger
+                raw = obs_ledger.preresolve(config, self.dataset.num_data,
+                                            self.dataset.num_features)
+                valid = {"tpu_partition_kernel": ("pallas", "xla"),
+                         "tpu_hist_kernel": ("pallas", "xla"),
+                         "tpu_work_layout": ("planes", "rows"),
+                         "tpu_resident_state": ("resident", "off")}
+                for k, v in raw.items():
+                    if k in valid and v in valid[k]:
+                        pre[k] = v
+                    elif k in ("tpu_part_chunk", "tpu_hist_chunk") \
+                            and isinstance(v, int) and v > 0:
+                        pre[k] = v
+
+            def _pre(knob):
+                """Consume a preresolved knob value (records + counts)."""
+                v = pre[knob]
+                telemetry.record("ledger_preresolution",
+                                 dedupe_key=(knob, v), knob=knob,
+                                 configured="auto", value=v,
+                                 reason="preresolved from run ledger")
+                telemetry.count("ledger/preresolved_knobs")
+                return v
+
             part_kernel = config.tpu_partition_kernel
             auto_kernel = part_kernel == "auto"
             part_why = ""
-            if auto_kernel:
+            if auto_kernel and "tpu_partition_kernel" in pre:
+                part_kernel = _pre("tpu_partition_kernel")
+                auto_kernel = False   # resolved; no fresh record below
+            elif auto_kernel:
                 # the fused DMA kernel needs Mosaic; CPU test meshes and
                 # non-TPU backends use the portable XLA pipeline
                 part_kernel = "pallas" if backend in ("tpu", "axon") else "xla"
@@ -1614,7 +1652,11 @@ class SerialTreeLearner:
                 part_why = ("packed row %d B exceeds the 512 B pallas DMA "
                             "window" % row_w)
             part_chunk = int(config.tpu_part_chunk)
-            if part_chunk <= 0:
+            auto_part_chunk = part_chunk <= 0
+            if auto_part_chunk and "tpu_part_chunk" in pre:
+                part_chunk = _pre("tpu_part_chunk")
+                auto_part_chunk = False
+            elif auto_part_chunk:
                 # measured on v5e: the XLA path optimum is 2048 (per-op
                 # overhead vs O(ch^2) compaction matmul); the pallas kernel
                 # has no per-op overhead, so 1024 halves the matmul work
@@ -1626,14 +1668,21 @@ class SerialTreeLearner:
                           "above 256, a multiple of the 256-row compaction "
                           "sub-block (got %d)", part_chunk)
             hist_chunk = int(config.tpu_hist_chunk)
-            if hist_chunk <= 0:
+            auto_hist_chunk = hist_chunk <= 0
+            if auto_hist_chunk and "tpu_hist_chunk" in pre:
+                hist_chunk = _pre("tpu_hist_chunk")
+                auto_hist_chunk = False
+            elif auto_hist_chunk:
                 # measured on v5e (lo_w-tuned einsum): 4096-row chunks win
                 # at F<=64; wide matrices spill VMEM — 1024 is ~8% faster
                 # than 2048 at F=137
                 hist_chunk = 4096 if self.bins.shape[1] <= 64 else 1024
             hist_kernel = config.tpu_hist_kernel
             auto_hist = hist_kernel == "auto"
-            if auto_hist:
+            if auto_hist and "tpu_hist_kernel" in pre:
+                hist_kernel = _pre("tpu_hist_kernel")
+                auto_hist = False
+            elif auto_hist:
                 # auto = xla: the in-VMEM pallas kernel is bit-identical
                 # and ~6x faster standalone, but in-situ (alternating with
                 # the partition kernel inside the tree while-loop) the axon
@@ -1657,7 +1706,10 @@ class SerialTreeLearner:
             layout = config.tpu_work_layout
             auto_layout = layout == "auto"
             layout_why = ""
-            if auto_layout:
+            if auto_layout and "tpu_work_layout" in pre:
+                layout = _pre("tpu_work_layout")
+                auto_layout = False
+            elif auto_layout:
                 # planes pay off when a packed row wastes most of a
                 # 128-lane DMA tile; at > 256 B row-major tiles are already
                 # >= 2-tile efficient. int8 keeps rows (no quantized planes
@@ -1681,6 +1733,7 @@ class SerialTreeLearner:
                             "quantized training; using rows")
                 layout = "rows"
             rs = config.tpu_resident_state
+            auto_rs = rs == "auto"
             if rs == "on":
                 if config.tpu_work_layout == "rows":
                     Log.fatal("tpu_resident_state=on requires the planes "
@@ -1690,7 +1743,12 @@ class SerialTreeLearner:
                               "quantized training (plane-family layouts "
                               "are hilo/bf16 only)")
                 layout = "resident"
-            elif rs == "auto" and layout == "planes" \
+            elif auto_rs and "tpu_resident_state" in pre:
+                if _pre("tpu_resident_state") == "resident" \
+                        and layout == "planes":
+                    layout = "resident"
+                auto_rs = False
+            elif auto_rs and layout == "planes" \
                     and backend in ("tpu", "axon"):
                 # resident state strictly reduces partition traffic where
                 # the planes layout already wins, and trees stay
@@ -1717,8 +1775,6 @@ class SerialTreeLearner:
             # auto-knob resolution records: what auto chose and why
             # (deduped, so repeated build_kwargs calls keep one record per
             # distinct resolution)
-            from .obs import telemetry
-
             def _rec(knob, value, reason):
                 telemetry.record("auto_resolution",
                                  dedupe_key=(knob, value, reason),
@@ -1734,7 +1790,7 @@ class SerialTreeLearner:
             if auto_layout:
                 _rec("tpu_work_layout", layout if layout != "resident"
                      else "planes", layout_why)
-            if rs == "auto":
+            if auto_rs:
                 _rec("tpu_resident_state",
                      "resident" if layout == "resident" else "off",
                      "planes layout on %s: resident gather strictly "
@@ -1742,6 +1798,12 @@ class SerialTreeLearner:
                      if layout == "resident" else
                      "layout %s on %s: resident gather has no payoff"
                      % (layout, backend))
+            if auto_part_chunk:
+                _rec("tpu_part_chunk", part_chunk,
+                     "%s kernel default chunk" % part_kernel)
+            if auto_hist_chunk:
+                _rec("tpu_hist_chunk", hist_chunk,
+                     "packed width %d default chunk" % self.bins.shape[1])
             kw.update(
                 hist_chunk=hist_chunk,
                 part_chunk=part_chunk,

@@ -14,8 +14,11 @@ from ..core import Finding, Project, Rule, register
 from ..graph import graph_for
 
 #: the traced hot phases: learner/fused drive the per-split loops, ops/
-#: holds the kernels, serve/ the resident inference path
-HOT_FILES = ("lightgbm_tpu/learner.py", "lightgbm_tpu/fused.py")
+#: holds the kernels, serve/ the resident inference path; obs_device
+#: builds the watchdog jit (its scalar fetch is host code by design, but
+#: nothing REACHABLE FROM the jit may sync)
+HOT_FILES = ("lightgbm_tpu/learner.py", "lightgbm_tpu/fused.py",
+             "lightgbm_tpu/obs_device.py")
 HOT_DIRS = ("lightgbm_tpu/ops/", "lightgbm_tpu/serve/",
             "lightgbm_tpu/linear/")
 

@@ -193,6 +193,26 @@ def trace_phase(name: str) -> Iterator[None]:
 _JIT_COMPILES_PREFIX = "jit/compiles/"
 _BACKEND_COMPILES = "jit/backend_compiles"
 _compile_listener_installed = False
+# Thread-local mute for the backend-compile listener. obs_device's AOT
+# cost capture re-compiles a signature the program ALREADY paid for; its
+# backend event would double-count in ``jit/backend_compiles`` (which the
+# compile-budget tests pin as "the program's own compiles").
+_suppress = threading.local()
+
+
+@contextlib.contextmanager
+def suppress_backend_compiles() -> Iterator[None]:
+    """Mute ``jit/backend_compiles`` for compiles issued by the current
+    thread inside the block (used by obs_device.on_compile around its AOT
+    re-compile). The duration still lands in ``device_cost/capture_s``,
+    so the capture cost stays visible — just not conflated with the
+    training path's compile count."""
+    prev = getattr(_suppress, "on", False)
+    _suppress.on = True
+    try:
+        yield
+    finally:
+        _suppress.on = prev
 # jax.monitoring listeners cannot be unregistered, so the "already
 # installed" marker must outlive THIS module object: a reloaded obs (or a
 # second copy imported under a different package path) re-running
@@ -221,6 +241,8 @@ def install_compile_listener() -> None:
 
         def _on_event(event: str, duration: float, **kw) -> None:
             if "backend_compile" in event:
+                if getattr(_suppress, "on", False):
+                    return
                 telemetry.count(_BACKEND_COMPILES)
                 telemetry.add_time("jit/backend_compile_s", duration)
 
@@ -259,6 +281,17 @@ class _TrackedJit:
             if size > self._seen:
                 telemetry.count(_JIT_COMPILES_PREFIX + self._name,
                                 size - self._seen)
+                # this exact signature just compiled: hand it to the
+                # device-cost capture (AOT cost/memory analysis). Lazy
+                # import breaks the obs <-> obs_device cycle; any capture
+                # failure is counted there, never raised into training.
+                try:
+                    from . import obs_device
+                    if obs_device.cost_capture_enabled():
+                        obs_device.on_compile(self._name, self._fn,
+                                              args, kwargs)
+                except Exception:  # pragma: no cover - capture is best-effort
+                    telemetry.count("device_cost/capture_errors")
             self._seen = size  # shrink = cache cleared; re-arm
         return out
 
@@ -512,6 +545,11 @@ class Telemetry:
         for lst in snap["records"].values():
             for r in lst:
                 r.pop("_key", None)
+        try:   # outside self._lock: obs_device has its own lock
+            from . import obs_device
+            snap["device_cost"] = obs_device.section()
+        except Exception:  # pragma: no cover - snapshot must never fail
+            snap["device_cost"] = {"enabled": False, "jits": {}, "hbm": {}}
         return snap
 
     def reset(self) -> None:

@@ -1,0 +1,286 @@
+"""Persistent run ledger: append-only JSONL of what each run cost and
+how its ``auto`` knobs resolved.
+
+The ROADMAP self-calibration item: auto-knob resolutions (recorded in
+telemetry since PR 3) evaporate with the process, so every fresh train on
+the same machine re-derives the same answers — and nothing persists what
+a run *cost*, so regressions are only caught while someone is watching a
+bench. The ledger fixes both with one file:
+
+- :func:`record_run` appends ONE JSON line per train/bench/serve run:
+  machine identity (host, backend, device kind/count), dataset shape,
+  a config fingerprint, every resolved auto knob, a compact telemetry
+  snapshot (counters/timers/compiles) and the device-cost section.
+- :func:`preresolve` reads the newest entry matching the current
+  (machine, dataset-shape, config) key and hands its resolved ``tpu_*``
+  knobs back to the learner, which applies them INSTEAD of re-running
+  auto resolution — a machine tunes itself once, then every later run
+  starts pre-resolved (zero new ``auto_resolution`` records; pinned in
+  tests/test_ledger.py).
+- ``scripts/ledger.py`` adds list/show/compare/gate CLI modes over the
+  same file; ``scripts/check.sh --ledger`` wires the gate into CI.
+
+Format notes: JSONL so appends are atomic-enough under POSIX (one
+``write`` of one line), the file is greppable, and partial/corrupt lines
+(a killed process mid-append) are skipped on read, never fatal. The
+module is import-light — no jax at import time — so ``scripts/ledger.py``
+can query a ledger on machines without an accelerator stack.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import socket
+import time
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from .utils.log import Log
+
+#: schema version stamped on every entry; readers skip newer majors
+LEDGER_VERSION = 1
+
+#: config fields excluded from the fingerprint: paths, dump targets and
+#: report knobs that vary between otherwise-identical runs, the ledger's
+#: own knobs (turning the ledger on must match entries recorded before),
+#: and data/valid (the dataset is keyed by SHAPE, not by path — the same
+#: matrix under a renamed file should still match)
+_FP_SKIP = frozenset({
+    "data", "valid", "input_model", "output_model", "output_result",
+    "convert_model", "convert_model_language", "verbosity",
+    "dump_telemetry", "dump_trace", "telemetry_dump_interval_s",
+    "snapshot_freq", "saved_feature_importance_type",
+    "obs_ledger", "obs_ledger_path", "obs_device_cost",
+    "obs_check_finite", "obs_hbm_sample_interval_s",
+})
+
+#: auto-knob prefix eligible for preresolution (ISSUE: "pre-resolves
+#: tpu_* auto knobs"); everything else in an entry is reporting-only
+_PRERESOLVE_PREFIX = "tpu_"
+
+
+def config_fingerprint(config) -> str:
+    """Stable hash of every perf-relevant config field (see _FP_SKIP).
+
+    The AUTO values are hashed, not the resolved ones — a run that was
+    pre-resolved from the ledger must produce the same fingerprint as the
+    run that recorded the entry, or the key would drift after one hop.
+    The learner guarantees this by never mutating the Config object.
+    """
+    parts: List[str] = []
+    for f in dataclasses.fields(config):
+        if f.name in _FP_SKIP or f.name.startswith("_"):
+            continue
+        parts.append("%s=%r" % (f.name, getattr(config, f.name)))
+    return hashlib.sha1(";".join(parts).encode()).hexdigest()[:16]
+
+
+def machine_identity() -> Dict[str, Any]:
+    """Host + accelerator identity. jax is imported lazily and a missing
+    or broken backend degrades to host-only identity (the CLI must be
+    able to stamp entries on a query-only machine)."""
+    ident: Dict[str, Any] = {"host": socket.gethostname()}
+    try:
+        import jax
+        devs = jax.local_devices()
+        ident["backend"] = jax.default_backend()
+        ident["device_kind"] = devs[0].device_kind if devs else "none"
+        ident["device_count"] = len(devs)
+    except Exception:
+        ident["backend"] = "unavailable"
+        ident["device_kind"] = "none"
+        ident["device_count"] = 0
+    return ident
+
+
+def _machine_key(ident: Dict[str, Any]) -> List[Any]:
+    # hostname intentionally NOT in the match key: "same machine" for
+    # knob resolution means same accelerator, not same DNS name — a
+    # ledger shipped between identical v5e hosts should still hit
+    return [ident.get("backend"), ident.get("device_kind"),
+            ident.get("device_count")]
+
+
+def resolved_knobs() -> Dict[str, Any]:
+    """Every auto-knob resolution of the CURRENT process, merged from the
+    live telemetry records: fresh resolutions (``auto_resolution``) and
+    ledger-applied ones (``ledger_preresolution``) — so an entry written
+    by a pre-resolved run still carries the full knob set forward."""
+    from .obs import telemetry
+    knobs: Dict[str, Any] = {}
+    for name in ("auto_resolution", "ledger_preresolution"):
+        for rec in telemetry.records(name):
+            k, v = rec.get("knob"), rec.get("value")
+            if k:
+                knobs[str(k)] = v
+    return knobs
+
+
+def build_entry(config, kind: str, rows: int, features: int,
+                extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """Assemble one ledger entry from the process's current telemetry.
+    Pure read — does not touch the ledger file."""
+    from .obs import telemetry
+    snap = telemetry.snapshot()
+    entry = {
+        "v": LEDGER_VERSION,
+        "ts": time.time(),   # graftlint: disable=naked-timer -- epoch timestamp, not a duration
+        "kind": kind,                      # train | bench | serve
+        "machine": machine_identity(),
+        "dataset": {"rows": int(rows), "features": int(features)},
+        "config_fp": config_fingerprint(config),
+        "resolved_knobs": resolved_knobs(),
+        "telemetry": {
+            "counters": snap.get("counters", {}),
+            "timers": snap.get("timers", {}),
+            "jit_compiles": snap.get("jit_compiles", {}),
+        },
+        "device_cost": snap.get("device_cost", {}),
+    }
+    if extra:
+        entry["extra"] = dict(extra)
+    return entry
+
+
+def append(path: str, entry: Dict[str, Any]) -> None:
+    """Append one entry as one JSONL line (one write call; creates the
+    file and parent directory on first use)."""
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    with open(path, "a", encoding="utf-8") as f:
+        f.write(json.dumps(entry, sort_keys=True) + "\n")
+
+
+def record_run(config, kind: str, rows: int, features: int,
+               extra: Optional[Dict[str, Any]] = None) -> Optional[dict]:
+    """build_entry + append to ``config.obs_ledger_path``. Never raises:
+    a read-only filesystem must not fail the training run it describes."""
+    try:
+        entry = build_entry(config, kind, rows, features, extra)
+        append(config.obs_ledger_path, entry)
+        from .obs import telemetry
+        telemetry.count("ledger/entries_written")
+        return entry
+    except Exception as exc:
+        Log.warning("ledger append failed (%s): %s",
+                    type(exc).__name__, exc)
+        return None
+
+
+def read_entries(path: str) -> Iterator[Dict[str, Any]]:
+    """Yield entries oldest-first; corrupt/partial lines and newer-major
+    entries are skipped (counted nowhere — the CLI reports them)."""
+    if not os.path.exists(path):
+        return
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                e = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(e, dict) and e.get("v", 0) <= LEDGER_VERSION:
+                yield e
+
+
+def _match(entry: Dict[str, Any], machine_key: List[Any], rows: int,
+           features: int, config_fp: str, kind: Optional[str]) -> bool:
+    ds = entry.get("dataset", {})
+    return (
+        _machine_key(entry.get("machine", {})) == machine_key
+        and ds.get("rows") == rows and ds.get("features") == features
+        and entry.get("config_fp") == config_fp
+        and (kind is None or entry.get("kind") == kind)
+    )
+
+
+def find_matching(path: str, config, rows: int, features: int,
+                  kind: Optional[str] = None,
+                  n: Optional[int] = None) -> List[Dict[str, Any]]:
+    """Entries matching the (machine, shape, config) key, oldest-first;
+    ``n`` keeps only the newest n."""
+    key = _machine_key(machine_identity())
+    fp = config_fingerprint(config)
+    out = [e for e in read_entries(path)
+           if _match(e, key, int(rows), int(features), fp, kind)]
+    return out[-n:] if n else out
+
+
+def preresolve(config, rows: int, features: int) -> Dict[str, Any]:
+    """The resolved ``tpu_*`` knobs of the newest matching entry, or {}.
+
+    The learner consults this once per build (when ``obs_ledger`` is on)
+    and applies the values to knobs still set to auto — skipping its own
+    resolution records for them, which is how the acceptance test
+    observes "zero new auto_resolution records" on the second run.
+    Returns {} on any problem: preresolution is an optimization, a
+    corrupt ledger must never block a train."""
+    try:
+        matches = find_matching(config.obs_ledger_path, config,
+                                rows, features, n=1)
+    except Exception as exc:
+        Log.warning("ledger preresolve failed (%s): %s",
+                    type(exc).__name__, exc)
+        return {}
+    if not matches:
+        return {}
+    knobs = matches[-1].get("resolved_knobs", {})
+    return {k: v for k, v in knobs.items()
+            if k.startswith(_PRERESOLVE_PREFIX)}
+
+
+# ---------------------------------------------------------------------------
+# Query / compare / gate (the scripts/ledger.py backend)
+# ---------------------------------------------------------------------------
+
+def metric_value(entry: Dict[str, Any], metric: str) -> Optional[float]:
+    """Dotted-path lookup (``extra.train_s``, ``telemetry.timers.fused/
+    device_wait``) returning a float or None. Path components are split
+    on the FIRST dots only until a dict key containing dots matches —
+    timer names contain '/', not '.', so plain split is unambiguous."""
+    node: Any = entry
+    for part in metric.split("."):
+        if not isinstance(node, dict) or part not in node:
+            return None
+        node = node[part]
+    try:
+        return float(node)
+    except (TypeError, ValueError):
+        return None
+
+
+def compare(a: Dict[str, Any], b: Dict[str, Any],
+            metrics: List[str]) -> List[Tuple[str, Optional[float],
+                                              Optional[float]]]:
+    """[(metric, value_in_a, value_in_b)] for reporting."""
+    return [(m, metric_value(a, m), metric_value(b, m)) for m in metrics]
+
+
+def gate(path: str, config, rows: int, features: int, metric: str,
+         tolerance: float, kind: Optional[str] = None) -> Tuple[bool, str]:
+    """Regression gate over the newest two matching entries: fail when
+    the newest is more than ``tolerance`` (fractional) worse than the
+    previous on ``metric`` (lower is better — the gated metrics are
+    times/bytes). Passes with an explanatory message when fewer than two
+    matching entries exist (first run on a machine must not fail CI)."""
+    matches = find_matching(path, config, rows, features, kind=kind, n=2)
+    if len(matches) < 2:
+        return True, ("ledger gate: %d matching entr%s at %s — nothing to "
+                      "compare, pass" % (len(matches),
+                                         "y" if len(matches) == 1 else "ies",
+                                         path))
+    prev, cur = matches[-2], matches[-1]
+    pv, cv = metric_value(prev, metric), metric_value(cur, metric)
+    if pv is None or cv is None:
+        return True, ("ledger gate: metric %r missing (prev=%r cur=%r) — "
+                      "pass" % (metric, pv, cv))
+    if pv <= 0:
+        return True, "ledger gate: previous %s=%g not positive — pass" % (
+            metric, pv)
+    ratio = cv / pv
+    msg = "ledger gate: %s prev=%.6g cur=%.6g ratio=%.3f tolerance=%.2f" % (
+        metric, pv, cv, ratio, 1.0 + tolerance)
+    return ratio <= 1.0 + tolerance, msg

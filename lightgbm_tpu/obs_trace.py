@@ -24,7 +24,12 @@ Spans are HOST-side: inside a jit trace ``phase_begin`` refuses to
 record (via ``jax.core.trace_state_clean``), so ``trace_phase`` sites
 that live in traced code cost nothing at runtime and do not pollute the
 recorder with trace-time measurements.  Device-side attribution stays
-with ``jax.named_scope`` / the XLA profiler.
+with ``jax.named_scope`` / the XLA profiler — but the fused finalize
+path splits its spans so device time is visible from host spans alone:
+``lgbtpu/fused_device_wait`` (an ``obs.sync`` completion barrier, pure
+device-execution wait) precedes ``lgbtpu/fused_flush`` (the actual
+result transfer), the host-span mirror of the ``device_s``/
+``transfer_s`` bench breakdown (PERF.md, ISSUE 10).
 
 Import-time this module is pure stdlib; jax is resolved lazily when
 tracing is first switched on.

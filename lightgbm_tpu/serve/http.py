@@ -240,6 +240,13 @@ class PredictServer:
             "requests": telemetry.counter("serve/requests"),
         }
         try:
+            from .. import obs_device
+            # compact device-cost view: HBM watermark + capture totals
+            # (full per-jit detail stays on /telemetry and /metrics)
+            doc["device_cost"] = obs_device.summary()
+        except Exception:  # pragma: no cover - health must never fail
+            pass
+        try:
             default = self.registry.get()
             # single-model back-compat: the old flat fields stay
             doc["model_version"] = default.booster.inner.model_version

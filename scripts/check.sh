@@ -6,15 +6,21 @@
 #   check.sh --fast     lint only files changed vs git + lint tests
 #   check.sh --slo      everything above, plus the closed-loop serving
 #                       SLO bench gated against SLO_BASELINE.json
+#   check.sh --ledger   everything above, plus the run-ledger regression
+#                       gate: train the fixed CI workload (appends one
+#                       ledger entry) and fail on >25% train-wall
+#                       regression vs the previous matching entry
 set -e
 cd "$(dirname "$0")/.."
 
 LINT_ARGS=""
 RUN_SUBSET=1
 RUN_SLO=0
+RUN_LEDGER=0
 case "$1" in
-    --fast) LINT_ARGS="--changed"; RUN_SUBSET=0 ;;
-    --slo)  RUN_SLO=1 ;;
+    --fast)   LINT_ARGS="--changed"; RUN_SUBSET=0 ;;
+    --slo)    RUN_SLO=1 ;;
+    --ledger) RUN_LEDGER=1 ;;
 esac
 
 echo "== graftlint =="
@@ -35,4 +41,12 @@ if [ "$RUN_SLO" = 1 ]; then
     echo "== serving SLO bench (vs SLO_BASELINE.json) =="
     JAX_PLATFORMS=cpu python scripts/slo_bench.py --quick \
         --against SLO_BASELINE.json
+fi
+
+if [ "$RUN_LEDGER" = 1 ]; then
+    echo "== run-ledger regression gate (scripts/ledger.py) =="
+    LEDGER_PATH="${LEDGER_PATH:-lgbtpu_ledger.jsonl}"
+    JAX_PLATFORMS=cpu python scripts/ledger.py train --path "$LEDGER_PATH"
+    JAX_PLATFORMS=cpu python scripts/ledger.py gate --path "$LEDGER_PATH" \
+        --metric extra.train_s --tolerance "${LEDGER_TOLERANCE:-0.25}"
 fi

@@ -1,0 +1,200 @@
+"""Query / compare / regression-gate CLI over the JSONL run ledger.
+
+Usage:
+    python scripts/ledger.py list   [--path L] [--kind train] [-n 10]
+    python scripts/ledger.py show   [--path L] [--index -1]
+    python scripts/ledger.py compare --metrics extra.train_s,... \
+                                    [--index-a -2] [--index-b -1]
+    python scripts/ledger.py train  [--path L] [--rows N] [--features F]
+    python scripts/ledger.py gate   [--path L] --metric extra.train_s \
+                                    [--tolerance 0.25]
+
+``train`` runs a small deterministic CI workload with ``obs_ledger`` on
+(appending one entry with its trusted train wall under ``extra.train_s``)
+and ``gate`` fails (exit 1) when the newest entry matching the same
+(machine, shape, config) key regressed more than ``--tolerance`` vs the
+previous one — the ``scripts/check.sh --ledger`` pair, same shape as the
+``--slo`` gate. ``gate`` passes when fewer than two matching entries
+exist, so the first run on a fresh machine cannot fail CI.
+
+Query modes never import jax-heavy modules until needed; a ledger copied
+off a TPU host can be inspected anywhere.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DEFAULT_PATH = os.path.join(REPO, "lgbtpu_ledger.jsonl")
+
+# the CI workload: fixed shape + params so every `train` run lands on the
+# same ledger match key (rows/features overridable for bigger machines)
+CI_ROWS, CI_FEATURES = 2000, 10
+CI_PARAMS = {
+    "objective": "binary", "num_leaves": 31, "verbosity": -1,
+    "tpu_iter_block": 5, "seed": 7,
+}
+CI_ROUNDS = 10
+
+
+def _entries(path, kind=None):
+    from lightgbm_tpu import obs_ledger
+    out = list(obs_ledger.read_entries(path))
+    if kind:
+        out = [e for e in out if e.get("kind") == kind]
+    return out
+
+
+def _pick(entries, index):
+    try:
+        return entries[index]
+    except IndexError:
+        sys.exit("ledger: no entry at index %d (have %d)"
+                 % (index, len(entries)))
+
+
+def _fmt_ts(ts):
+    return time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(float(ts)))
+
+
+def cmd_list(args):
+    entries = _entries(args.path, args.kind)
+    if args.n:
+        entries = entries[-args.n:]
+    if not entries:
+        print("ledger: no entries at %s" % args.path)
+        return 0
+    print("%-4s %-19s %-6s %-8s %10s %5s  %-16s %s"
+          % ("idx", "ts", "kind", "backend", "rows", "feat",
+             "config_fp", "knobs"))
+    base = len(_entries(args.path, args.kind))
+    for i, e in enumerate(entries):
+        ds, m = e.get("dataset", {}), e.get("machine", {})
+        print("%-4d %-19s %-6s %-8s %10s %5s  %-16s %d"
+              % (i - len(entries) + base, _fmt_ts(e.get("ts", 0)),
+                 e.get("kind", "?"), m.get("backend", "?"),
+                 ds.get("rows", "?"), ds.get("features", "?"),
+                 e.get("config_fp", "?"),
+                 len(e.get("resolved_knobs", {}))))
+    return 0
+
+
+def cmd_show(args):
+    entries = _entries(args.path, args.kind)
+    print(json.dumps(_pick(entries, args.index), indent=2, sort_keys=True))
+    return 0
+
+
+def cmd_compare(args):
+    from lightgbm_tpu import obs_ledger
+    entries = _entries(args.path, args.kind)
+    a, b = _pick(entries, args.index_a), _pick(entries, args.index_b)
+    metrics = [m for m in args.metrics.split(",") if m]
+    print("%-40s %14s %14s %8s" % ("metric", "a", "b", "b/a"))
+    for m, va, vb in obs_ledger.compare(a, b, metrics):
+        ratio = ("%8.3f" % (vb / va)) if va and vb is not None else "     n/a"
+        print("%-40s %14s %14s %s"
+              % (m, "n/a" if va is None else "%.6g" % va,
+                 "n/a" if vb is None else "%.6g" % vb, ratio))
+    return 0
+
+
+def _ci_config(path, rows, features):
+    from lightgbm_tpu.config import Config
+    params = dict(CI_PARAMS, obs_ledger=True, obs_ledger_path=path)
+    return Config.from_params(params), params
+
+
+def cmd_train(args):
+    import numpy as np
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu import obs
+
+    rows, features = args.rows, args.features
+    rng = np.random.RandomState(7)
+    X = rng.rand(rows, features).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0.75).astype(np.float32)
+    _, params = _ci_config(args.path, rows, features)
+    obs.telemetry.reset()
+    ds = lgb.Dataset(X, label=y)
+    booster = None
+    with obs.wall("ledger_ci_train") as w:
+        booster = lgb.train(params, ds, num_boost_round=CI_ROUNDS)
+        obs.sync(booster.inner.train_score.score)   # trusted wall: end in a transfer
+    # the engine already appended the run entry; stamp the trusted train
+    # wall into a second, richer entry the gate compares on
+    from lightgbm_tpu import obs_ledger
+    entry = obs_ledger.record_run(
+        booster.inner.config, "bench", rows, features,
+        extra={"train_s": round(w.seconds, 6), "rounds": CI_ROUNDS})
+    print(json.dumps({"train_s": round(w.seconds, 6),
+                      "rows": rows, "features": features,
+                      "entry_written": entry is not None,
+                      "path": args.path}))
+    return 0 if entry is not None else 1
+
+
+def cmd_gate(args):
+    from lightgbm_tpu import obs_ledger
+    cfg, _ = _ci_config(args.path, args.rows, args.features)
+    ok, msg = obs_ledger.gate(args.path, cfg, args.rows, args.features,
+                              args.metric, args.tolerance, kind="bench")
+    print(("PASS " if ok else "FAIL ") + msg)
+    return 0 if ok else 1
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    def common(p):
+        p.add_argument("--path", default=DEFAULT_PATH)
+        p.add_argument("--kind", default=None,
+                       help="filter: train | bench | serve")
+
+    p = sub.add_parser("list", help="table of entries")
+    common(p)
+    p.add_argument("-n", type=int, default=20)
+    p.set_defaults(fn=cmd_list)
+
+    p = sub.add_parser("show", help="dump one entry as JSON")
+    common(p)
+    p.add_argument("--index", type=int, default=-1)
+    p.set_defaults(fn=cmd_show)
+
+    p = sub.add_parser("compare", help="metric diff between two entries")
+    common(p)
+    p.add_argument("--metrics",
+                   default="extra.train_s,"
+                           "telemetry.timers.fused/device_wait,"
+                           "telemetry.timers.fused/logs_transfer,"
+                           "telemetry.jit_compiles.total")
+    p.add_argument("--index-a", type=int, default=-2)
+    p.add_argument("--index-b", type=int, default=-1)
+    p.set_defaults(fn=cmd_compare)
+
+    p = sub.add_parser("train", help="run the CI workload, append entry")
+    common(p)
+    p.add_argument("--rows", type=int, default=CI_ROWS)
+    p.add_argument("--features", type=int, default=CI_FEATURES)
+    p.set_defaults(fn=cmd_train)
+
+    p = sub.add_parser("gate", help="fail on regression vs previous entry")
+    common(p)
+    p.add_argument("--rows", type=int, default=CI_ROWS)
+    p.add_argument("--features", type=int, default=CI_FEATURES)
+    p.add_argument("--metric", default="extra.train_s")
+    p.add_argument("--tolerance", type=float, default=0.25,
+                   help="fractional regression allowed (0.25 = +25%%)")
+    p.set_defaults(fn=cmd_gate)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,206 @@
+"""Device-cost observability (ISSUE 10 tentpole).
+
+Pins the obs_device contracts: every tracked-jit compile yields a
+cost/memory capture (FLOPs, bytes accessed, HBM footprint) visible in
+``Booster.telemetry()["device_cost"]`` and as Prometheus families; the
+live-HBM sampler degrades to a counted no-op on CPU; the
+``obs_check_finite`` watchdog catches injected NaN gradients in warn and
+raise modes; and the off modes add ZERO tracked compiles and ZERO device
+ops (the compile-budget harness from tests/test_retrace.py).
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+import lightgbm_tpu as lgb  # noqa: E402
+from lightgbm_tpu import obs, obs_device  # noqa: E402
+from lightgbm_tpu.utils.log import LightGBMError  # noqa: E402
+
+PARAMS = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+          "tpu_iter_block": 5}
+
+
+# NOT test_retrace.py's (600, 8): these suites share the cross-Booster
+# block cache, and retrace's "first train" must stay genuinely cold
+def _data(n=560, f=7, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = (X[:, 0] + 0.1 * rng.randn(n) > 0).astype(np.float64)
+    return X, y
+
+
+def _fresh():
+    obs.telemetry.reset()
+    obs_device.reset()
+    obs_device.configure(cost_enabled=True)
+
+
+# ------------------------------------------------------------- cost capture
+
+def test_device_cost_section_after_train():
+    """Any backend: a train must land per-jit FLOPs/bytes/HBM aggregates
+    in the telemetry device_cost section, including the fused block."""
+    _fresh()
+    X, y = _data()
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train(dict(PARAMS), ds, num_boost_round=5)
+    snap = bst.telemetry()
+    sec = snap["device_cost"]
+    assert sec["enabled"] is True
+    assert sec["jits"], "no captures despite fresh compiles"
+    assert "fused/run_block" in sec["jits"], sorted(sec["jits"])
+    entry = sec["jits"]["fused/run_block"]
+    assert entry["compiles"] >= 1
+    assert entry["flops"] > 0
+    assert entry["bytes_accessed"] > 0
+    # memory_analysis fields present (values may be 0 on some backends)
+    for key in ("argument_bytes", "output_bytes", "temp_bytes",
+                "generated_code_bytes"):
+        assert key in entry
+    # the watermark section is always present
+    assert "peak_bytes" in sec["hbm"]
+
+
+def test_device_cost_prometheus_families():
+    _fresh()
+    X, y = _data()
+    ds = lgb.Dataset(X, label=y)
+    lgb.train(dict(PARAMS), ds, num_boost_round=3)
+    text = obs.prometheus_text()
+    assert "lgbtpu_device_cost_flops_" in text
+    assert "lgbtpu_device_cost_bytes_accessed_" in text
+    assert "lgbtpu_device_cost_temp_hbm_bytes_" in text
+
+
+def test_capture_does_not_inflate_backend_compiles():
+    """The AOT re-compile inside on_compile runs under the suppression
+    context: jit/backend_compiles keeps counting only the program's own
+    compiles (one here), not the capture's."""
+    _fresh()
+
+    @jax.jit
+    def f(x):
+        return (x * 2.0).sum()
+
+    g = obs.track_jit("test/suppress", f)
+    x = jnp.ones((16,))     # array creation may itself backend-compile
+    before = obs.telemetry.counter("jit/backend_compiles")
+    g(x)
+    assert obs.telemetry.counter("device_cost/captures") == 1
+    # one program compile; the capture's AOT re-compile is suppressed
+    assert obs.telemetry.counter("jit/backend_compiles") - before == 1
+
+
+def test_capture_off_is_zero_overhead():
+    """obs_device_cost=False: no captures, no capture timers, and the
+    tracked-jit path stays identical (compile counts unchanged)."""
+    _fresh()
+    obs_device.configure(cost_enabled=False)
+    try:
+        @jax.jit
+        def f(x):
+            return x + 1
+
+        g = obs.track_jit("test/capoff", f)
+        g(jnp.ones((8,)))
+        assert obs.jit_compiles().get("test/capoff") == 1
+        assert obs.telemetry.counter("device_cost/captures") == 0
+        snap = obs.telemetry.snapshot()
+        assert snap["device_cost"]["jits"] == {}
+        assert "device_cost/capture_s" not in snap["timers"]
+    finally:
+        obs_device.configure(cost_enabled=True)
+
+
+# ---------------------------------------------------------------- HBM stats
+
+def test_cpu_memory_stats_graceful_noop():
+    """CPU jax has no device.memory_stats(): the sampler returns None,
+    counts the no-op, and section() reports supported=False — never an
+    exception."""
+    if jax.default_backend() != "cpu":
+        pytest.skip("backend has real memory stats")
+    _fresh()
+    assert obs_device.sample_hbm() is None
+    assert obs.telemetry.counter("obs_device/hbm_sample_noop") == 1
+    sec = obs_device.section()
+    assert sec["hbm"]["supported"] is False
+    assert sec["hbm"]["peak_bytes"] == 0
+    # the boundary sampler stops re-probing once unsupported
+    assert obs_device.maybe_sample_hbm() is None
+    assert obs.telemetry.counter("obs_device/hbm_sample_noop") == 1
+
+
+def test_hbm_summary_shape():
+    _fresh()
+    s = obs_device.summary()
+    for key in ("hbm_supported", "hbm_peak_bytes", "captured_jits",
+                "total_flops"):
+        assert key in s
+
+
+# ----------------------------------------------------------------- watchdog
+
+def _nan_fobj(preds, dataset):
+    g = np.full(len(preds), np.nan)
+    h = np.ones(len(preds))
+    return g, h
+
+
+def test_watchdog_warn_counts_nan_grads():
+    _fresh()
+    X, y = _data(300, 6)
+    p = dict(PARAMS, obs_check_finite="warn")
+    ds = lgb.Dataset(X, label=y, params=dict(p))
+    lgb.train(p, ds, num_boost_round=1, fobj=_nan_fobj)
+    assert obs.telemetry.counter("obs/nonfinite_grads") > 0
+    assert obs.telemetry.counter("obs/finite_checks") >= 1
+
+
+def test_watchdog_raise_aborts_on_nan_grads():
+    _fresh()
+    X, y = _data(300, 6)
+    p = dict(PARAMS, obs_check_finite="raise")
+    ds = lgb.Dataset(X, label=y, params=dict(p))
+    with pytest.raises(LightGBMError, match="non-finite"):
+        lgb.train(p, ds, num_boost_round=1, fobj=_nan_fobj)
+
+
+def test_watchdog_clean_training_raises_nothing():
+    """raise mode on healthy data: checks run, nothing trips — including
+    the fused-path per-block score check."""
+    _fresh()
+    X, y = _data(400, 6)
+    p = dict(PARAMS, obs_check_finite="raise")
+    ds = lgb.Dataset(X, label=y, params=dict(p))
+    bst = lgb.train(p, ds, num_boost_round=3)
+    assert bst.inner.iter_ == 3
+    assert obs.telemetry.counter("obs/finite_checks") >= 1
+    assert obs.telemetry.counter("obs/nonfinite_scores") == 0
+
+
+def test_watchdog_off_zero_device_ops():
+    """The acceptance pin: obs_check_finite=off (the default) must add
+    ZERO tracked compiles and ZERO device ops — asserted with the
+    compile-budget harness: a warm second train still compiles nothing,
+    and the watchdog's own jit never appears."""
+    _fresh()
+    X, y = _data()
+    ds = lgb.Dataset(X, label=y)
+    lgb.train(dict(PARAMS), ds, num_boost_round=5)      # warm every cache
+    obs.telemetry.reset()
+    bst = lgb.train(dict(PARAMS), ds, num_boost_round=5)
+    jc = bst.telemetry()["jit_compiles"]
+    assert jc["total"] == 0, jc
+    assert jc["backend_compiles"] == 0, jc
+    assert "obs/check_finite" not in jc["per_function"]
+    assert obs.telemetry.counter("obs/finite_checks") == 0
+    assert obs.telemetry.counter("obs/nonfinite_grads") == 0
