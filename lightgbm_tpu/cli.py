@@ -190,7 +190,14 @@ class Application:
         ("default"); ``online_train=true`` attaches an OnlineTrainer per
         model (POST /ingest feeds it) — see lightgbm_tpu/online/.
         SIGTERM drains gracefully: new requests get 503, queued work
-        finishes, telemetry/trace dumps fire, exit 0."""
+        finishes, telemetry/trace dumps fire, exit 0.
+
+        Fleet mode (``fleet_dir=...``): ``fleet_role=trainer`` persists
+        ingest/gate/publish events in the durable store, replays them on
+        boot, and publishes every promotion/rollback as a version-tokened
+        artifact; ``fleet_role=replica`` serves without training, watching
+        the store and hot-swapping each published version through the
+        adopt path — see lightgbm_tpu/fleet/."""
         cfg = self.config
         entries = []
         if cfg.input_model:
@@ -198,8 +205,22 @@ class Application:
         for spec in cfg.serve_models:
             mid, path = spec.split("=", 1)
             entries.append((mid.strip(), path.strip()))
-        if not entries:
+        if not entries and not cfg.fleet_dir:
             Log.fatal("task=serve requires input_model or serve_models")
+        fleet_trainer = bool(cfg.fleet_dir) and cfg.fleet_role == "trainer"
+        fleet_replica = bool(cfg.fleet_dir) and cfg.fleet_role == "replica"
+        if fleet_trainer and not cfg.online_train:
+            Log.fatal("fleet_role=trainer requires online_train=true (the "
+                      "trainer is the process that publishes promotions)")
+        if fleet_replica and cfg.online_train:
+            Log.fatal("fleet_role=replica is serve-only (replicas apply "
+                      "published models, they never train); drop "
+                      "online_train or use fleet_role=trainer")
+        if cfg.fleet_dir and len(entries) > 1:
+            Log.fatal("fleet mode serves one model per store; drop "
+                      "serve_models or run one process per model")
+        if fleet_replica and not entries:
+            entries = [("default", "")]   # bootstrap purely from the store
         online_cfg = None
         if cfg.online_train:
             online_cfg = dict(
@@ -212,27 +233,71 @@ class Application:
                 min_rows=cfg.online_min_rows,
                 continue_rounds=cfg.online_continue_rounds,
                 decay_rate=cfg.refit_decay_rate,
-                shadow_decay=cfg.online_shadow_decay)
+                shadow_decay=cfg.online_shadow_decay,
+                promote_patience=cfg.online_promote_patience,
+                rollback_threshold=cfg.online_rollback_threshold,
+                rollback_min_rows=cfg.online_rollback_min_rows)
+        tenant_weights = {}
+        for spec in cfg.serve_tenant_weights:
+            name, _, w = spec.partition("=")
+            tenant_weights[name.strip()] = float(w)
         from .online import ModelRegistry
         from .serve.http import PredictServer
         registry = ModelRegistry()
+        watcher = None
         for mid, path in entries:
-            registry.register(
-                mid, Booster(model_file=path),
+            booster, applied = None, 0
+            store = None
+            if cfg.fleet_dir:
+                from .fleet import FleetStore, bootstrap_model
+                store = FleetStore(cfg.fleet_dir, mid)
+                booster, applied = bootstrap_model(store)
+                if booster is not None:
+                    Log.info("fleet: %s booted from published v%d",
+                             mid, applied)
+            if booster is None:
+                if not path:
+                    Log.fatal("fleet: store %s has no published model yet "
+                              "and no input_model to seed from",
+                              cfg.fleet_dir)
+                booster = Booster(model_file=path)
+                if fleet_trainer and store.latest_publish() is None:
+                    # seed the store so replicas can boot before the
+                    # first promotion
+                    store.publish(booster.model_to_string(), event="boot")
+            model_online = None
+            if online_cfg is not None:
+                model_online = dict(online_cfg)
+                if fleet_trainer:
+                    model_online.update(store=store,
+                                        replay=cfg.fleet_replay)
+            entry = registry.register(
+                mid, booster,
                 buckets=cfg.serve_buckets or None,
                 max_batch_rows=cfg.serve_max_batch_rows,
                 max_wait_ms=cfg.serve_max_wait_ms,
                 max_queue_rows=cfg.serve_max_queue_rows,
                 overload=cfg.serve_overload,
+                tenant_quota_rows=cfg.serve_tenant_quota_rows,
+                tenant_weights=tenant_weights or None,
                 raw_score=cfg.predict_raw_score,
                 warmup=cfg.serve_warmup,
-                online=dict(online_cfg) if online_cfg else None)
+                online=model_online)
+            if fleet_replica:
+                from .fleet import ReplicaWatcher
+                watcher = ReplicaWatcher(
+                    entry.booster, store,
+                    poll_interval_s=cfg.fleet_poll_interval_s,
+                    applied_version=applied)
         server = PredictServer(registry=registry, host=cfg.serve_host,
                                port=cfg.serve_port)
+        server.fleet_watcher = watcher
         host, port = server.address
         Log.info("Serving %s on http://%s:%d (POST /predict, /ingest; GET "
-                 "/healthz, /models, /telemetry, /metrics)",
-                 ", ".join("%s=%s" % e for e in entries), host, port)
+                 "/healthz, /models, /telemetry, /metrics)%s",
+                 ", ".join("%s=%s" % e for e in entries), host, port,
+                 " [fleet %s @ %s]" % (cfg.fleet_role, cfg.fleet_dir)
+                 if cfg.fleet_dir else "")
         stop_dump = None
         if cfg.dump_telemetry and cfg.telemetry_dump_interval_s > 0:
             # a wedged server still leaves fresh counters on disk

@@ -227,6 +227,12 @@ class Config:
     #   | block (submitters wait for queue space; drains preserve order)
     serve_models: List[str] = field(default_factory=list)  # multi-tenant:
     #   extra "model_id=path" entries served next to input_model ("default")
+    # per-tenant fairness (serve.batcher weighted-fair dequeue):
+    serve_tenant_quota_rows: int = 0  # cap on any ONE tenant's queued rows
+    #   (0 = no per-tenant cap; over-quota requests shed/block per
+    #   serve_overload while other tenants keep being admitted)
+    serve_tenant_weights: List[str] = field(default_factory=list)
+    #   "tenant=weight" fair-share weights (unlisted tenants weigh 1.0)
 
     # ---- online training (task=serve + online_train: lightgbm_tpu/online/) ----
     online_train: bool = False        # run an OnlineTrainer per served model
@@ -244,6 +250,23 @@ class Config:
     online_shadow_decay: float = 1.0  # per-row exponential decay toward the
     #   oldest shadow row when scoring (1.0 = uniform window, current
     #   behavior; 0<d<1 weights recent traffic more)
+    online_promote_patience: int = 1  # promotion hysteresis: candidate must
+    #   win this many CONSECUTIVE shadow evaluations before the swap
+    online_rollback_threshold: float = 0.0  # post-promotion live watch:
+    #   auto-rollback when promoted live loss > threshold * displaced
+    #   model's on traffic ingested AFTER the swap (0 = watch off)
+    online_rollback_min_rows: int = 64  # fresh labeled rows required
+    #   before the live watch renders its verdict
+
+    # ---- fleet (task=serve --fleet: lightgbm_tpu/fleet/) ----
+    fleet_dir: str = ""               # durable store root ("" = fleet off):
+    #   <fleet_dir>/<model_id>/{events.jsonl, models/v*.txt}
+    fleet_role: str = "trainer"       # trainer (ingest + train + publish)
+    #   | replica (serve-only, watch the store and hot-swap publishes)
+    fleet_poll_interval_s: float = 0.5  # replica publish-poll cadence
+    fleet_replay: bool = True         # replay the event log on trainer boot
+    #   (rows past the consumed watermark re-enter the training buffer,
+    #   older rows only the shadow window)
 
     # ---- objective (reference: config.h "Objective Parameters") ----
     num_class: int = 1
@@ -429,6 +452,36 @@ class Config:
         if not 0.0 < self.online_shadow_decay <= 1.0:
             Log.fatal("online_shadow_decay must be in (0, 1], got %g",
                       self.online_shadow_decay)
+        if self.online_promote_patience < 1:
+            Log.fatal("online_promote_patience must be >= 1, got %d",
+                      self.online_promote_patience)
+        if self.online_rollback_threshold < 0:
+            Log.fatal("online_rollback_threshold must be >= 0 (0 = live "
+                      "watch off), got %g", self.online_rollback_threshold)
+        if self.online_rollback_min_rows < 1:
+            Log.fatal("online_rollback_min_rows must be >= 1, got %d",
+                      self.online_rollback_min_rows)
+        if self.serve_tenant_quota_rows < 0:
+            Log.fatal("serve_tenant_quota_rows must be >= 0 (0 = no "
+                      "per-tenant cap), got %d", self.serve_tenant_quota_rows)
+        for spec in self.serve_tenant_weights:
+            name, _, w = spec.partition("=")
+            try:
+                ok = bool(name.strip()) and float(w) > 0
+            except ValueError:
+                ok = False
+            if not ok:
+                Log.fatal("serve_tenant_weights entries must be "
+                          "tenant=positive_weight, got %r", spec)
+        if self.fleet_role not in ("trainer", "replica"):
+            Log.fatal("fleet_role must be trainer or replica; got %s",
+                      self.fleet_role)
+        if self.fleet_poll_interval_s <= 0:
+            Log.fatal("fleet_poll_interval_s must be > 0, got %g",
+                      self.fleet_poll_interval_s)
+        if self.fleet_dir == "" and self.fleet_role == "replica":
+            Log.fatal("fleet_role=replica requires a fleet_dir (the store "
+                      "the replica watches)")
         if self.linear_device not in ("auto", "off", "on"):
             Log.fatal("linear_device must be auto, off or on; got %s",
                       self.linear_device)

@@ -1,0 +1,27 @@
+"""Fleet serving: durable online state + multi-replica model distribution.
+
+The online/ subsystem (PR 8) trains, shadow-gates and hot-swaps models
+under live load, but it is single-process and amnesiac. This package
+adds the fleet layer on top of it:
+
+- :class:`~lightgbm_tpu.fleet.store.FleetStore` — a durable JSONL store
+  (the PR-10 ledger substrate: one-write appends, corrupt-line skip)
+  holding the ingest stream, the promotion-gate history and
+  version-tokened whole-model artifacts. A restarted trainer replays it
+  and resumes its shadow window instead of cold-starting.
+- :class:`~lightgbm_tpu.fleet.replica.ReplicaWatcher` — the
+  multi-process story: one trainer process publishes promoted models
+  through the store, N serving replicas watch it and hot-swap through
+  the existing ``GBDT.adopt`` path, so every replica serves whole
+  historical models only (one version bump per applied publish).
+
+Per-tenant fairness (admission quotas + weighted-fair dequeue) lives in
+:mod:`lightgbm_tpu.serve.batcher`; promotion hysteresis and the
+auto-rollback live-metric watch live in
+:mod:`lightgbm_tpu.online.trainer` — this package provides the
+durability and distribution substrate they plug into.
+"""
+from .replica import ReplicaWatcher, bootstrap_model
+from .store import FleetStore
+
+__all__ = ["FleetStore", "ReplicaWatcher", "bootstrap_model"]

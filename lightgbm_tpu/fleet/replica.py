@@ -1,0 +1,179 @@
+"""Serving replicas: watch the fleet store, hot-swap whole models.
+
+One trainer process publishes promoted models as version-tokened
+artifacts (:meth:`~lightgbm_tpu.fleet.store.FleetStore.publish`); each
+serving replica runs a :class:`ReplicaWatcher` that polls the store and
+adopts newer versions through the existing ``Booster.adopt`` path — the
+same single-version-bump atomic swap the in-process online trainer uses,
+so every concurrent ``PredictSession`` snapshot on the replica sees the
+old ensemble or the new one whole. This is the single-trainer /
+many-workers decomposition of arXiv:1611.01276 applied to serving:
+replicas never train, they only apply whole historical models.
+
+Rollbacks distribute the same way: the trainer publishes the restored
+model under a NEW version token, and replicas converge by always
+applying the newest token (exactly one local version bump per applied
+publish — pinned in tests/test_fleet.py).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from ..obs import telemetry
+from ..obs_trace import tracer
+from ..utils.log import LightGBMError, Log
+from .store import FleetStore
+
+
+def bootstrap_model(store: FleetStore):
+    """(booster, version) from the store's newest publish, or (None, 0)
+    when nothing was published yet (the replica then needs an
+    ``input_model`` to boot from)."""
+    latest = store.latest_publish()
+    if latest is None:
+        return None, 0
+    from ..basic import Booster
+    return Booster(model_str=store.load_model(latest["version"])), \
+        int(latest["version"])
+
+
+class _ArtifactLoader:
+    """Thread-confined model build for one swap: constructed fresh per
+    applied publish, so the candidate booster it parses is private to
+    that poll (graftlint's thread-reachability stops at a freshly-
+    constructed receiver — the online trainer's _CandidateBuilder
+    pattern), and the only shared-model call left on the poller thread
+    is the lock-guarded ``adopt``."""
+
+    def __init__(self, store: FleetStore) -> None:
+        self._store = store
+
+    def load(self, version: int):
+        from ..basic import Booster
+        return Booster(model_str=self._store.load_model(version))
+
+
+class ReplicaWatcher:
+    """Poll the store for newer published versions and hot-swap them
+    into one serving booster.
+
+    ``start=True`` (default) runs a named daemon thread polling every
+    ``poll_interval_s``; tests drive :meth:`poll_once` synchronously with
+    ``start=False``. Each applied publish is one ``Booster.adopt`` — one
+    version bump, whole model, never a partial state.
+    """
+
+    def __init__(self, booster, store: FleetStore, *,
+                 poll_interval_s: float = 0.5,
+                 applied_version: int = 0,
+                 start: bool = True) -> None:
+        if poll_interval_s <= 0:
+            raise LightGBMError("fleet poll_interval_s must be > 0, "
+                                "got %g" % poll_interval_s)
+        self._booster = booster
+        self._store = store
+        self._poll = float(poll_interval_s)
+        # guards the applied-version token and the swap counters (the
+        # poller thread writes them, /healthz handler threads read), and
+        # doubles as the poller's wakeup so close() never waits a full
+        # poll interval
+        self._lock = threading.Condition()
+        self._applied = int(applied_version)
+        self._swaps = 0
+        self._errors = 0
+        self._last_error = ""
+        self._last_swap_ts = 0.0
+        self._stopped = False
+        telemetry.gauge("fleet/applied_version", self._applied)
+        self._thread: Optional[threading.Thread] = None
+        if start:
+            self._thread = threading.Thread(
+                target=self._worker, name="lgbtpu-fleet-replica",
+                daemon=True)
+            self._thread.start()
+
+    # ----------------------------------------------------------------- polling
+    def poll_once(self) -> bool:
+        """Check the store once; adopt a newer version if one was
+        published. Returns True when a swap happened."""
+        latest = self._store.latest_publish()
+        if latest is None:
+            return False
+        version = int(latest["version"])
+        with self._lock:
+            if version <= self._applied:
+                return False
+        # the artifact is a complete historical model (os.replace'd
+        # before its event): build the private candidate off-lock, then
+        # adopt — ONE version bump, whole-model invariant held
+        loader = _ArtifactLoader(self._store)
+        candidate = loader.load(version)
+        with tracer.span("fleet/replica_swap", domain="serve",
+                         version=version):
+            self._booster.adopt(candidate)
+        with self._lock:
+            self._applied = version
+            self._swaps += 1
+            self._last_swap_ts = time.time()  # graftlint: disable=naked-timer -- epoch timestamp, not a duration
+        telemetry.count("fleet/replica_swaps")
+        telemetry.gauge("fleet/applied_version", version)
+        Log.info("fleet: replica adopted published model v%d (%s)",
+                 version, latest.get("event"))
+        return True
+
+    def _worker(self) -> None:
+        while True:
+            with self._lock:
+                if self._stopped:
+                    return
+                self._lock.wait(timeout=self._poll)
+                if self._stopped:
+                    return
+            try:
+                self.poll_once()
+            except Exception as exc:
+                # a torn read or transient FS error must not kill the
+                # watcher: count it and retry next poll
+                with self._lock:
+                    self._errors += 1
+                    self._last_error = "%s: %s" % (type(exc).__name__, exc)
+                telemetry.count("fleet/replica_poll_errors")
+                Log.warning("fleet: replica poll failed: %s: %s",
+                            type(exc).__name__, exc)
+
+    # ------------------------------------------------------------------- state
+    @property
+    def applied_version(self) -> int:
+        with self._lock:
+            return self._applied
+
+    def state(self) -> Dict[str, Any]:
+        """JSON-serializable watcher state (surfaced on /healthz)."""
+        with self._lock:
+            return {
+                "running": self._thread.is_alive()
+                if self._thread is not None else False,
+                "applied_version": self._applied,
+                "swaps": self._swaps,
+                "poll_errors": self._errors,
+                "last_error": self._last_error,
+                "last_swap_ts": self._last_swap_ts,
+                "poll_interval_s": self._poll,
+            }
+
+    # ---------------------------------------------------------------- shutdown
+    def close(self, timeout: Optional[float] = None) -> None:
+        """Stop the poller thread. Idempotent."""
+        with self._lock:
+            self._stopped = True
+            self._lock.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    def __enter__(self) -> "ReplicaWatcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,214 @@
+"""Durable fleet state: one directory per served model.
+
+    <root>/<model_id>/events.jsonl      append-only event log
+    <root>/<model_id>/models/v%06d.txt  immutable whole-model artifacts
+
+The event log rides the PR-10 ledger substrate
+(:func:`~lightgbm_tpu.obs_ledger.append_jsonl` /
+:func:`~lightgbm_tpu.obs_ledger.read_jsonl`): every append is ONE write
+call of one JSON line, so concurrent writers (HTTP ingest handlers, the
+trainer worker) interleave whole lines and a SIGKILL mid-append leaves at
+most one partial line, skipped on read. Three event kinds:
+
+- ``ingest``: one labeled traffic chunk (rows + labels). Replayed on
+  boot so a restarted server resumes its shadow window and training
+  buffer instead of cold-starting.
+- ``gate``: one promotion-gate cycle (result, consecutive-win count for
+  promotion hysteresis, the consumed-row watermark separating
+  already-trained traffic from still-buffered traffic).
+- ``publish``: a whole model became servable under a monotonically
+  increasing **version token**. The artifact is written to a temp file
+  and ``os.replace``d into place BEFORE the event lands, so a replica
+  that sees the event always reads a complete model — whole historical
+  models only, never a torn artifact.
+
+Rollbacks are publishes too (``event="rollback"``): replicas converge by
+always applying the newest version token, so a rollback distributes
+exactly like a promotion.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+import numpy as np
+
+from ..obs import telemetry
+from ..obs_ledger import append_jsonl, read_jsonl
+from ..utils.log import LightGBMError
+
+#: schema version stamped on every event; readers skip newer majors
+STORE_VERSION = 1
+
+#: publish-event reasons (reporting only — replicas apply them all)
+PUBLISH_EVENTS = ("boot", "promotion", "rollback")
+
+_ARTIFACT_FMT = "v%06d.txt"
+
+
+class FleetStore:
+    """Durable event log + model-artifact directory for one served model.
+
+    Thread-safe: appends arrive from HTTP handler threads (ingest) and
+    the trainer worker (gate/publish); reads come from replica-watcher
+    threads and boot-time replay. The in-memory counters exist only for
+    cheap ``state()`` snapshots — the file is the source of truth.
+    """
+
+    def __init__(self, root: str, model_id: str = "default") -> None:
+        model_id = str(model_id)
+        if not model_id or "/" in model_id or model_id.startswith("."):
+            raise LightGBMError("fleet model_id must be a plain name, "
+                                "got %r" % model_id)
+        self._root = os.path.abspath(root)
+        self._model_id = model_id
+        self._dir = os.path.join(self._root, model_id)
+        self._events_path = os.path.join(self._dir, "events.jsonl")
+        self._models_dir = os.path.join(self._dir, "models")
+        os.makedirs(self._models_dir, exist_ok=True)
+        # guards version allocation and the state counters; file appends
+        # are one-write atomic on their own but publish must allocate the
+        # next version token and write the artifact before its event
+        self._lock = threading.Lock()
+        latest = self._scan_latest_publish()
+        self._last_version = latest["version"] if latest else 0
+        self._ingest_rows = 0
+        self._publishes = 0
+
+    # ---------------------------------------------------------------- identity
+    @property
+    def root(self) -> str:
+        return self._root
+
+    @property
+    def model_id(self) -> str:
+        return self._model_id
+
+    @property
+    def events_path(self) -> str:
+        return self._events_path
+
+    # ----------------------------------------------------------------- append
+    def _stamp(self, kind: str, payload: Dict[str, Any]) -> Dict[str, Any]:
+        entry = {"v": STORE_VERSION, "kind": kind,
+                 "ts": time.time()}  # graftlint: disable=naked-timer -- epoch timestamp, not a duration
+        entry.update(payload)
+        return entry
+
+    def append_ingest(self, X, y) -> None:
+        """Persist one labeled traffic chunk (one JSONL line). Called on
+        the ingest path BEFORE the in-memory buffer push, so a crash
+        after the append replays the chunk instead of losing it."""
+        X = np.asarray(X, np.float64)
+        if X.ndim == 1:
+            X = X[None, :]
+        y = np.asarray(y, np.float64).ravel()
+        append_jsonl(self._events_path, self._stamp("ingest", {
+            "n": int(len(y)), "rows": X.tolist(), "labels": y.tolist()}))
+        with self._lock:
+            self._ingest_rows += int(len(y))
+        telemetry.count("fleet/ingest_rows_persisted", int(len(y)))
+
+    def append_gate(self, result: str, wins: int, consumed_rows: int,
+                    losses: Optional[Dict[str, float]] = None) -> None:
+        """Persist one promotion-gate cycle: its verdict, the
+        consecutive-win counter (promotion-hysteresis state a restarted
+        trainer must resume), and the consumed-row watermark (rows
+        ingested before it are already trained — replay keeps them out
+        of the training buffer but in the shadow window)."""
+        append_jsonl(self._events_path, self._stamp("gate", {
+            "result": str(result), "wins": int(wins),
+            "consumed_rows": int(consumed_rows),
+            "losses": losses}))
+
+    # ---------------------------------------------------------------- publish
+    def publish(self, model_str: str, event: str = "promotion",
+                meta: Optional[Dict[str, Any]] = None) -> int:
+        """Publish one whole model under the next version token.
+
+        The artifact is written to a temp path and ``os.replace``d (atomic
+        on POSIX) before the publish event is appended — a watcher that
+        sees the event can always read the complete artifact. Returns the
+        allocated version token."""
+        if event not in PUBLISH_EVENTS:
+            raise LightGBMError("publish event must be one of %s, got %r"
+                                % ("|".join(PUBLISH_EVENTS), event))
+        with self._lock:
+            version = self._last_version + 1
+            name = _ARTIFACT_FMT % version
+            final = os.path.join(self._models_dir, name)
+            tmp = final + ".tmp.%d" % os.getpid()
+            view = memoryview(model_str.encode("utf-8"))
+            fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
+            try:
+                done = 0
+                while done < len(view):
+                    done += os.write(fd, view[done:])
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+            os.replace(tmp, final)
+            append_jsonl(self._events_path, self._stamp("publish", {
+                "version": version, "artifact": name, "event": event,
+                "meta": dict(meta) if meta else None}))
+            self._last_version = version
+            self._publishes += 1
+        telemetry.count("fleet/publishes")
+        telemetry.gauge("fleet/published_version", version)
+        return version
+
+    # ------------------------------------------------------------------ read
+    def events(self, kind: Optional[str] = None) -> Iterator[Dict[str, Any]]:
+        """Events oldest-first (corrupt/partial lines skipped)."""
+        for e in read_jsonl(self._events_path, max_version=STORE_VERSION):
+            if kind is None or e.get("kind") == kind:
+                yield e
+
+    def _scan_latest_publish(self) -> Optional[Dict[str, Any]]:
+        latest: Optional[Dict[str, Any]] = None
+        for e in self.events("publish"):
+            v = e.get("version")
+            if isinstance(v, int) and (latest is None
+                                       or v > latest["version"]):
+                latest = e
+        return latest
+
+    def latest_publish(self) -> Optional[Dict[str, Any]]:
+        """Newest publish event whose artifact exists on disk, or None.
+        Re-reads the log, so a replica polling this sees other
+        processes' publishes."""
+        latest = self._scan_latest_publish()
+        if latest is None:
+            return None
+        if not os.path.exists(self.artifact_path(latest["version"])):
+            return None
+        with self._lock:
+            if latest["version"] > self._last_version:
+                self._last_version = latest["version"]
+        return latest
+
+    def artifact_path(self, version: int) -> str:
+        return os.path.join(self._models_dir, _ARTIFACT_FMT % int(version))
+
+    def load_model(self, version: int) -> str:
+        """The whole-model string published under ``version``."""
+        with open(self.artifact_path(version), "r", encoding="utf-8") as f:
+            return f.read()
+
+    def publishes(self) -> List[Dict[str, Any]]:
+        """All publish events oldest-first."""
+        return list(self.events("publish"))
+
+    # ------------------------------------------------------------------ state
+    def state(self) -> Dict[str, Any]:
+        """JSON-serializable store summary (surfaced on /healthz)."""
+        with self._lock:
+            return {
+                "root": self._root,
+                "model_id": self._model_id,
+                "last_published_version": self._last_version,
+                "publishes_this_process": self._publishes,
+                "ingest_rows_persisted": self._ingest_rows,
+            }

@@ -14,13 +14,14 @@ from ..core import Finding, Project, Rule, register
 from ..graph import graph_for
 
 #: the traced hot phases: learner/fused drive the per-split loops, ops/
-#: holds the kernels, serve/ the resident inference path; obs_device
-#: builds the watchdog jit (its scalar fetch is host code by design, but
-#: nothing REACHABLE FROM the jit may sync)
+#: holds the kernels, serve/ the resident inference path, fleet/ the
+#: replica hot-swap feeding it; obs_device builds the watchdog jit (its
+#: scalar fetch is host code by design, but nothing REACHABLE FROM the
+#: jit may sync)
 HOT_FILES = ("lightgbm_tpu/learner.py", "lightgbm_tpu/fused.py",
              "lightgbm_tpu/obs_device.py")
 HOT_DIRS = ("lightgbm_tpu/ops/", "lightgbm_tpu/serve/",
-            "lightgbm_tpu/linear/")
+            "lightgbm_tpu/linear/", "lightgbm_tpu/fleet/")
 
 _SYNC_ATTR_CALLS = {"item", "tolist", "block_until_ready"}
 _SYNC_DOTTED = {"numpy.asarray", "numpy.array", "numpy.ascontiguousarray",

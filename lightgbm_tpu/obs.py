@@ -568,6 +568,15 @@ class Telemetry:
 telemetry = Telemetry()
 
 
+def safe_metric_part(part: str, max_len: int = 48) -> str:
+    """Untrusted id (e.g. an HTTP tenant name) -> safe registry-key
+    segment: alnum/dash/underscore only, bounded length, never empty.
+    Keeps caller-controlled strings from exploding the flat metric
+    namespace or smuggling separators into Prometheus names."""
+    s = re.sub(r"[^a-zA-Z0-9_\-]", "_", str(part))[:max_len]
+    return s or "_"
+
+
 # ---------------------------------------------------------------------------
 # Prometheus text exposition
 # ---------------------------------------------------------------------------

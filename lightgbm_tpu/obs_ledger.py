@@ -143,13 +143,45 @@ def build_entry(config, kind: str, rows: int, features: int,
     return entry
 
 
-def append(path: str, entry: Dict[str, Any]) -> None:
-    """Append one entry as one JSONL line (one write call; creates the
-    file and parent directory on first use)."""
+def append_jsonl(path: str, entry: Dict[str, Any]) -> None:
+    """The durable-append substrate (shared with ``lightgbm_tpu.fleet``):
+    one entry as one JSONL line written in ONE write call — atomic-enough
+    under POSIX appends, so concurrent writers interleave whole lines and
+    a killed process leaves at most one partial line (skipped on read).
+    Creates the file and parent directory on first use."""
     d = os.path.dirname(os.path.abspath(path))
     os.makedirs(d, exist_ok=True)
     with open(path, "a", encoding="utf-8") as f:
         f.write(json.dumps(entry, sort_keys=True) + "\n")
+
+
+def read_jsonl(path: str,
+               max_version: Optional[int] = None) -> Iterator[Dict[str, Any]]:
+    """Yield dict lines oldest-first, skipping blank/corrupt/partial
+    lines (a killed writer mid-append must never poison the file) and —
+    when ``max_version`` is given — entries whose ``v`` field is newer
+    than the reader understands."""
+    if not os.path.exists(path):
+        return
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                e = json.loads(line)
+            except ValueError:
+                continue
+            if not isinstance(e, dict):
+                continue
+            if max_version is not None and e.get("v", 0) > max_version:
+                continue
+            yield e
+
+
+def append(path: str, entry: Dict[str, Any]) -> None:
+    """Append one ledger entry (see :func:`append_jsonl`)."""
+    append_jsonl(path, entry)
 
 
 def record_run(config, kind: str, rows: int, features: int,
@@ -171,19 +203,7 @@ def record_run(config, kind: str, rows: int, features: int,
 def read_entries(path: str) -> Iterator[Dict[str, Any]]:
     """Yield entries oldest-first; corrupt/partial lines and newer-major
     entries are skipped (counted nowhere — the CLI reports them)."""
-    if not os.path.exists(path):
-        return
-    with open(path, "r", encoding="utf-8") as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                e = json.loads(line)
-            except ValueError:
-                continue
-            if isinstance(e, dict) and e.get("v", 0) <= LEDGER_VERSION:
-                yield e
+    yield from read_jsonl(path, max_version=LEDGER_VERSION)
 
 
 def _match(entry: Dict[str, Any], machine_key: List[Any], rows: int,

@@ -42,11 +42,16 @@ class TrafficBuffer:
         self._total = 0
 
     # ------------------------------------------------------------- ingest
-    def push(self, X, y) -> int:
+    def push(self, X, y, training: bool = True) -> int:
         """Append one labeled chunk; returns the buffered row count.
         Oldest training chunks are dropped once over capacity (a single
         chunk larger than the whole buffer is kept — it is the freshest
-        data there is)."""
+        data there is).
+
+        ``training=False`` feeds ONLY the shadow window: fleet replay
+        uses it for rows before the consumed-row watermark, which the
+        restarted trainer must judge promotions on but must not train on
+        a second time."""
         X = np.ascontiguousarray(np.asarray(X, np.float64))
         if X.ndim == 1:
             X = X[None, :]
@@ -61,13 +66,14 @@ class TrafficBuffer:
             with self._lock:
                 return self._rows
         with self._lock:
-            self._chunks.append((X, y))
-            self._rows += len(y)
+            if training:
+                self._chunks.append((X, y))
+                self._rows += len(y)
+                while self._rows > self._cap and len(self._chunks) > 1:
+                    _, oy = self._chunks.popleft()
+                    self._rows -= len(oy)
+                    self._dropped += len(oy)
             self._total += len(y)
-            while self._rows > self._cap and len(self._chunks) > 1:
-                _, oy = self._chunks.popleft()
-                self._rows -= len(oy)
-                self._dropped += len(oy)
             self._shadow.append((X, y))
             self._shadow_held += len(y)
             while self._shadow_held > self._shadow_cap \

@@ -37,10 +37,13 @@ class RegistryEntry:
 
     def info(self) -> Dict[str, Any]:
         """JSON-serializable per-model state (surfaced on /healthz)."""
+        stats = getattr(self.batcher, "tenant_stats", None)
         return {
             "model_version": self.booster.inner.model_version,
             "buckets": list(self.session.buckets),
             "queue_rows": self.batcher.queue_rows(),
+            # fake batchers in tests may predate the tenant surface
+            "tenants": stats() if callable(stats) else {},
             "age_s": round(obs.monotonic() - self.created_at, 3),
             "online": self.online.state() if self.online is not None
             else None,
@@ -69,6 +72,7 @@ class ModelRegistry:
     def register(self, model_id: str, booster, *, buckets=None,
                  max_batch_rows: int = 8192, max_wait_ms: float = 2.0,
                  max_queue_rows: int = 0, overload: str = "shed",
+                 tenant_quota_rows: int = 0, tenant_weights=None,
                  raw_score: bool = False, warmup: bool = False,
                  online=None) -> RegistryEntry:
         """Build and register the serving stack for one model.
@@ -88,7 +92,9 @@ class ModelRegistry:
         batcher = MicroBatcher(session, max_batch_rows=max_batch_rows,
                                max_wait_ms=max_wait_ms, raw_score=raw_score,
                                max_queue_rows=max_queue_rows,
-                               overload=overload)
+                               overload=overload,
+                               tenant_quota_rows=tenant_quota_rows,
+                               tenant_weights=tenant_weights)
         trainer = online
         if isinstance(online, dict):
             trainer = OnlineTrainer(booster, **online)

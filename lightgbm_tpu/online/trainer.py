@@ -16,6 +16,28 @@ concurrent ``PredictSession`` snapshot sees the old ensemble or the new
 one whole — never a half-committed pack. The displaced model is retained
 as a rollback token (:meth:`OnlineTrainer.rollback`).
 
+Fleet extensions (PR 11), all off by default:
+
+- **Hysteresis** (``promote_patience``): a candidate must win K
+  CONSECUTIVE shadow evaluations before the swap happens — one lucky
+  window on drifting traffic no longer flips the serving model
+  (``run_once`` returns ``"deferred"`` for intermediate wins).
+- **Auto-rollback** (``rollback_threshold``): after a promotion the
+  trainer keeps the displaced model string and watches traffic ingested
+  AFTER the swap; once ``rollback_min_rows`` fresh labeled rows arrive it
+  scores promoted vs. displaced on them and rolls back automatically if
+  the promoted model's live loss exceeds ``rollback_threshold`` x the
+  displaced model's. The shadow gate judges the PAST; this watch judges
+  the future the gate could not see.
+- **Durability** (``store``): a :class:`~lightgbm_tpu.fleet.FleetStore`
+  persists every ingest chunk, every gate verdict (with the
+  consecutive-win counter and a consumed-row watermark) and publishes
+  every promotion/rollback as a version-tokened whole-model artifact. On
+  boot the trainer replays the store: rows at or below the watermark
+  re-enter ONLY the shadow window (already trained — replaying them into
+  the training buffer would double-train), rows above it re-enter both,
+  and the hysteresis win-streak resumes where the dead process left it.
+
 Telemetry: ``online/ingested_rows``, ``online/train_runs``,
 ``online/promotions``, ``online/rejections``, ``online/train_errors``
 counters; ``online/train_ms``, ``online/shadow_ms``,
@@ -26,7 +48,8 @@ recorder (domain ``online`` records whenever the serve chain does).
 from __future__ import annotations
 
 import threading
-from typing import Any, Dict, Optional
+import time
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -42,6 +65,30 @@ MODES = ("refit", "continue")
 #: uses a sigmoid that never saturates to exactly 0/1; host-side clipping
 #: keeps a degenerate candidate finite instead of -inf)
 _EPS = 1e-15
+
+
+def _objective_loss(model, X: np.ndarray, y: np.ndarray,
+                    w: Optional[np.ndarray] = None) -> float:
+    """Objective-matched (weighted) mean loss: logloss for binary,
+    multi-logloss for multiclass, MSE otherwise (predictions come back
+    transformed, so probabilities are directly comparable). Shared by the
+    shadow gate and the post-promotion live watch — both must judge by
+    the same yardstick or a promotion could pass one and fail the
+    other on scale alone."""
+    pred = np.asarray(model.predict(X), np.float64)
+    obj = getattr(model.inner.objective, "name", "") \
+        if model.inner.objective is not None else ""
+    n = len(y)
+    if obj == "binary":
+        p = np.clip(pred.ravel(), _EPS, 1.0 - _EPS)
+        per_row = -(y * np.log(p) + (1 - y) * np.log(1 - p))
+    elif obj.startswith("multiclass"):
+        p = pred.reshape(n, -1)
+        picked = p[np.arange(n), y.astype(np.int64)]
+        per_row = -np.log(np.clip(picked, _EPS, 1.0))
+    else:
+        per_row = (pred.ravel() - y) ** 2
+    return float(np.average(per_row, weights=w))
 
 
 class _CandidateBuilder:
@@ -98,27 +145,31 @@ class _CandidateBuilder:
             # so live drift dominates the promotion verdict
             w = self._shadow_decay ** np.arange(len(y) - 1, -1, -1,
                                                 dtype=np.float64)
-        return self._loss(incumbent, X, y, w), self._loss(candidate, X, y, w)
+        return (_objective_loss(incumbent, X, y, w),
+                _objective_loss(candidate, X, y, w))
 
-    def _loss(self, model, X: np.ndarray, y: np.ndarray,
-              w: Optional[np.ndarray] = None) -> float:
-        """Objective-matched (weighted) mean loss: logloss for binary,
-        multi-logloss for multiclass, MSE otherwise (predictions come back
-        transformed, so probabilities are directly comparable)."""
-        pred = np.asarray(model.predict(X), np.float64)
-        obj = getattr(model.inner.objective, "name", "") \
-            if model.inner.objective is not None else ""
-        n = len(y)
-        if obj == "binary":
-            p = np.clip(pred.ravel(), _EPS, 1.0 - _EPS)
-            per_row = -(y * np.log(p) + (1 - y) * np.log(1 - p))
-        elif obj.startswith("multiclass"):
-            p = pred.reshape(n, -1)
-            picked = p[np.arange(n), y.astype(np.int64)]
-            per_row = -np.log(np.clip(picked, _EPS, 1.0))
-        else:
-            per_row = (pred.ravel() - y) ** 2
-        return float(np.average(per_row, weights=w))
+
+class _WatchScorer:
+    """Thread-confined scorer for one live-watch verdict.
+
+    Same confinement contract as :class:`_CandidateBuilder`: constructed
+    fresh per evaluation from serialized model strings, so the boosters
+    it builds and scores are private to that call — graftlint's
+    thread-reachability stops at a freshly-constructed receiver, keeping
+    the predict internals out of the worker thread's shared-state
+    closure."""
+
+    def __init__(self, cand_str: str, prev_str: str) -> None:
+        self._cand = cand_str
+        self._prev = prev_str
+
+    def losses(self, X: np.ndarray, y: np.ndarray) -> tuple:
+        """(promoted_loss, displaced_loss) on the post-swap traffic."""
+        from ..basic import Booster
+        promoted = Booster(model_str=self._cand)
+        displaced = Booster(model_str=self._prev)
+        return (_objective_loss(promoted, X, y),
+                _objective_loss(displaced, X, y))
 
 
 class OnlineTrainer:
@@ -145,6 +196,10 @@ class OnlineTrainer:
                  continue_params: Optional[Dict[str, Any]] = None,
                  decay_rate: Optional[float] = None,
                  shadow_decay: float = 1.0,
+                 promote_patience: int = 1,
+                 rollback_threshold: float = 0.0,
+                 rollback_min_rows: int = 64,
+                 store=None, replay: bool = True,
                  candidate_factory=None,
                  start: bool = True) -> None:
         if mode not in MODES:
@@ -153,6 +208,15 @@ class OnlineTrainer:
         if not 0.0 < float(shadow_decay) <= 1.0:
             raise LightGBMError("online shadow_decay must be in (0, 1], "
                                 "got %g" % shadow_decay)
+        if promote_patience < 1:
+            raise LightGBMError("online promote_patience must be >= 1, "
+                                "got %d" % promote_patience)
+        if rollback_threshold < 0:
+            raise LightGBMError("online rollback_threshold must be >= 0 "
+                                "(0 disables the live watch), got %g"
+                                % rollback_threshold)
+        if rollback_min_rows < 1:
+            raise LightGBMError("online rollback_min_rows must be >= 1")
         if not hasattr(booster, "refit") or not hasattr(booster, "inner"):
             raise LightGBMError(
                 "OnlineTrainer needs a lightgbm_tpu.Booster (refit and "
@@ -170,6 +234,13 @@ class OnlineTrainer:
         self._continue_rounds = int(continue_rounds)
         self._decay = decay_rate
         self._shadow_decay = float(shadow_decay)
+        self._patience = int(promote_patience)
+        self._rb_threshold = float(rollback_threshold)
+        self._rb_min_rows = int(rollback_min_rows)
+        # the fleet store is duck-typed (append_ingest/append_gate/
+        # publish/events) so the trainer stays importable without the
+        # fleet package and tests can inject fakes
+        self._store = store
         # test/extension hook: a callable (X, y) -> Booster replaces the
         # default candidate build (degraded-candidate gate tests)
         self._candidate_factory = candidate_factory
@@ -207,6 +278,19 @@ class OnlineTrainer:
         self._last_losses: Optional[Dict[str, float]] = None
         self._rollback: Optional[tuple] = None
         self._last_train_t = obs.monotonic()
+        # hysteresis win-streak, consumed-row watermark (rows drained
+        # into a train cycle — the replay boundary between shadow-only
+        # and trainable traffic) and the post-promotion live watch
+        self._wins = 0
+        self._consumed_rows = 0
+        self._replayed_rows = 0
+        self._auto_rollbacks = 0
+        self._last_promotion_ts = 0.0
+        self._last_rollback_ts = 0.0
+        self._watch: Optional[Dict[str, Any]] = None
+        self._watch_chunks: List[Tuple[np.ndarray, np.ndarray]] = []
+        if self._store is not None and replay:
+            self._replay()
         # pre-touch the promotion counters so a freshly-started online
         # server exposes the whole family on /metrics before the first
         # train cycle (dashboards key on the series existing)
@@ -225,15 +309,93 @@ class OnlineTrainer:
         """Add labeled rows (features, labels) to the training buffer and
         shadow window; returns the buffered row count. Called from HTTP
         handler threads (POST /ingest) or embedding code; never blocks on
-        training."""
+        training.
+
+        With a fleet store the chunk is persisted BEFORE the in-memory
+        push — a crash after the append replays the chunk on restart
+        instead of losing it; a crash before it loses a chunk the caller
+        never saw acknowledged."""
+        X_arr = np.asarray(X, np.float64)
         y_arr = np.asarray(y, np.float64).ravel()
-        buffered = self.buffer.push(X, y_arr)
+        if self._store is not None:
+            self._store.append_ingest(X_arr, y_arr)
+        buffered = self.buffer.push(X_arr, y_arr)
+        self._feed_watch(X_arr, y_arr)
         telemetry.count("online/ingested_rows", int(y_arr.size))
         telemetry.gauge("online/buffered_rows", buffered)
         if buffered >= self._trigger_rows:
             with self._lock:
                 self._lock.notify_all()
         return buffered
+
+    def _feed_watch(self, X: np.ndarray, y: np.ndarray) -> None:
+        """Route fresh post-promotion traffic into the live watch (the
+        rollback verdict must come from rows the promoted model is
+        actually serving, not from the shadow window the gate already
+        judged)."""
+        with self._lock:
+            watch = self._watch
+            if watch is None or watch["rows"] >= self._rb_min_rows:
+                return
+            if X.ndim == 1:
+                X = X[None, :]
+            self._watch_chunks.append((X, y))
+            watch["rows"] += int(len(y))
+            armed = watch["rows"] >= self._rb_min_rows
+        if armed:
+            with self._lock:
+                self._lock.notify_all()
+
+    # --------------------------------------------------------------- replay
+    def _replay(self) -> None:
+        """Rebuild buffer + hysteresis state from the fleet store.
+
+        Gate events carry the consumed-row watermark: ingest rows at or
+        below it were already drained into a train cycle by the dead
+        process, so they re-enter ONLY the shadow window (training on
+        them again would double-count their gradient signal); rows above
+        it re-enter the training buffer too. The win-streak resumes from
+        the newest gate event."""
+        events = list(self._store.events())
+        watermark = 0
+        for e in events:
+            if e.get("kind") == "gate":
+                watermark = max(watermark, int(e.get("consumed_rows", 0)))
+                self._wins = int(e.get("wins", 0))
+        seen = 0
+        replayed = 0
+        for e in events:
+            if e.get("kind") != "ingest":
+                continue
+            try:
+                X = np.asarray(e["rows"], np.float64)
+                y = np.asarray(e["labels"], np.float64).ravel()
+            except (KeyError, TypeError, ValueError):
+                continue   # a malformed entry must not block the boot
+            if X.ndim == 1:
+                X = X[None, :]
+            if len(y) == 0 or X.shape[0] != len(y):
+                continue
+            lo, hi = seen, seen + len(y)
+            seen = hi
+            if hi <= watermark:
+                self.buffer.push(X, y, training=False)
+            elif lo >= watermark:
+                self.buffer.push(X, y)
+            else:
+                # chunk straddles the watermark: split it so only the
+                # untrained tail re-enters the training buffer
+                cut = watermark - lo
+                self.buffer.push(X[:cut], y[:cut], training=False)
+                self.buffer.push(X[cut:], y[cut:])
+            replayed += len(y)
+        self._consumed_rows = min(watermark, seen)
+        self._replayed_rows = replayed
+        if replayed:
+            telemetry.count("fleet/replayed_rows", replayed)
+            Log.info("fleet: replayed %d ingest rows (%d shadow-only at "
+                     "watermark %d), win-streak=%d", replayed,
+                     min(watermark, seen), watermark, self._wins)
 
     # --------------------------------------------------------------- worker
     def _worker(self) -> None:
@@ -248,6 +410,18 @@ class OnlineTrainer:
                 self._lock.wait(timeout=poll)
                 if self._stopped:
                     return
+            try:
+                # the live watch outranks training: a regressed model
+                # should be rolled back before another cycle builds a
+                # candidate on top of it
+                self.watch_once()
+            except BaseException as exc:
+                telemetry.count("online/train_errors")
+                with self._lock:
+                    self._errors += 1
+                    self._last_error = "%s: %s" % (type(exc).__name__, exc)
+                Log.warning("online: live watch failed: %s: %s",
+                            type(exc).__name__, exc)
             if self._should_train():
                 try:
                     self.run_once()
@@ -276,8 +450,10 @@ class OnlineTrainer:
     def run_once(self) -> str:
         """One synchronous train cycle: drain the buffer, build a
         candidate, shadow-score it, promote or reject. Returns
-        ``"promoted"``, ``"rejected"`` or ``"skipped"`` (not enough
-        data). Tests call this directly with ``start=False``."""
+        ``"promoted"``, ``"rejected"``, ``"deferred"`` (shadow win
+        banked toward ``promote_patience``, no swap yet) or
+        ``"skipped"`` (not enough data). Tests call this directly with
+        ``start=False``."""
         with self._lock:
             self._last_train_t = obs.monotonic()
         data = self.buffer.take_training()
@@ -321,15 +497,48 @@ class OnlineTrainer:
                           "rows": int(len(ys))}
                 accept = bool(np.isfinite(cand)
                               and cand <= self._threshold * cur + 1e-12)
+            # the drained rows are consumed either way — a rejected
+            # candidate's training data is gone too, so the replay
+            # watermark advances on every real cycle
+            with self._lock:
+                self._consumed_rows += int(len(y))
+                consumed = self._consumed_rows
             if accept:
+                with self._lock:
+                    self._wins += 1
+                    wins = self._wins
+                if wins < self._patience:
+                    # hysteresis: a win is banked, not acted on, until
+                    # the streak reaches promote_patience
+                    telemetry.count("online/deferrals")
+                    self._record_gate("deferred", wins, consumed, losses)
+                    self._finish("deferred", losses)
+                    return "deferred"
+                with self._lock:
+                    self._wins = 0
                 self._promote(candidate, builder.serialize(candidate), src)
+                self._record_gate("promoted", 0, consumed, losses)
                 self._finish("promoted", losses)
                 return "promoted"
             telemetry.count("online/rejections")
             with self._lock:
                 self._rejections += 1
+                self._wins = 0   # a loss breaks the streak
+            self._record_gate("rejected", 0, consumed, losses)
             self._finish("rejected", losses)
             return "rejected"
+
+    def _record_gate(self, result: str, wins: int, consumed: int,
+                     losses) -> None:
+        if self._store is None:
+            return
+        try:
+            self._store.append_gate(result, wins, consumed, losses)
+        except Exception as exc:
+            # durability is best-effort on a full/broken disk; the live
+            # promotion decision already happened
+            Log.warning("fleet: gate append failed: %s: %s",
+                        type(exc).__name__, exc)
 
     # ------------------------------------------------------------ promotion
     def _promote(self, candidate, cand_str: str, prev_str: str) -> None:
@@ -342,9 +551,28 @@ class OnlineTrainer:
             self._rollback = (token, prev_str)
             self._model_str = cand_str
             self._promotions += 1
+            self._last_promotion_ts = time.time()  # graftlint: disable=naked-timer -- epoch timestamp, not a duration
+            if self._rb_threshold > 0:
+                # arm the live watch: the verdict comes from traffic
+                # ingested from here on, which the shadow gate never saw
+                self._watch = {"cand_str": cand_str, "prev_str": prev_str,
+                               "rows": 0}
+                self._watch_chunks = []
         telemetry.count("online/promotions")
         telemetry.gauge("online/model_version",
                         self._booster.inner.model_version)
+        self._publish("promotion", cand_str)
+
+    def _publish(self, event: str, model_str: str,
+                 meta: Optional[Dict[str, Any]] = None) -> None:
+        if self._store is None:
+            return
+        try:
+            self._store.publish(model_str, event=event, meta=meta)
+        except Exception as exc:
+            # replicas simply keep serving the previous published version
+            Log.warning("fleet: publish(%s) failed: %s: %s", event,
+                        type(exc).__name__, exc)
 
     def rollback(self) -> bool:
         """Restore the model displaced by the last promotion (single
@@ -353,13 +581,67 @@ class OnlineTrainer:
         with self._lock:
             tok = self._rollback
             self._rollback = None
+            self._watch = None   # the watched promotion is being undone
+            self._watch_chunks = []
         if tok is None:
             return False
         snapshot, prev_str = tok
         self._booster.restore(snapshot)
         with self._lock:
             self._model_str = prev_str
+            self._last_rollback_ts = time.time()  # graftlint: disable=naked-timer -- epoch timestamp, not a duration
         telemetry.count("online/rollbacks")
+        # a rollback distributes like any publish: replicas converge on
+        # the newest version token, which is now the restored model
+        self._publish("rollback", prev_str)
+        return True
+
+    # ------------------------------------------------------------ live watch
+    def watch_once(self) -> Optional[bool]:
+        """Evaluate the post-promotion live watch if it is armed and
+        ``rollback_min_rows`` fresh labeled rows arrived since the swap:
+        score promoted vs. displaced on exactly those rows and roll back
+        when the promoted model's live loss exceeds
+        ``rollback_threshold`` x the displaced model's.
+
+        One verdict per promotion. Returns True (rolled back), False
+        (promotion confirmed, watch disarmed) or None (nothing to do
+        yet). The worker calls this every tick; tests with ``start=False``
+        drive it directly."""
+        with self._lock:
+            watch = self._watch
+            if watch is None or watch["rows"] < self._rb_min_rows:
+                return None
+            self._watch = None   # claim it: one evaluation, one verdict
+            chunks = self._watch_chunks
+            self._watch_chunks = []
+        X = np.concatenate([c[0] for c in chunks], axis=0)
+        y = np.concatenate([c[1] for c in chunks])
+        # private rebuilds from strings: scoring never touches the live
+        # serving booster
+        scorer = _WatchScorer(watch["cand_str"], watch["prev_str"])
+        with telemetry.timed_observe("online/watch_ms"), \
+                tracer.span("online/live_watch", domain="online",
+                            rows=int(len(y))):
+            cand, prev = scorer.losses(X, y)
+        losses = {"promoted": float(cand), "displaced": float(prev),
+                  "threshold": self._rb_threshold, "rows": int(len(y))}
+        regressed = bool(not np.isfinite(cand)
+                         or cand > self._rb_threshold * prev + 1e-12)
+        if not regressed:
+            Log.info("online: live watch confirmed promotion "
+                     "(promoted=%.6g displaced=%.6g)", cand, prev)
+            telemetry.count("online/watch_confirms")
+            self._finish("confirmed", losses)
+            return False
+        Log.warning("online: live loss regressed past bound "
+                    "(promoted=%.6g > %.2f x displaced=%.6g) — rolling "
+                    "back", cand, self._rb_threshold, prev)
+        telemetry.count("online/auto_rollbacks")
+        with self._lock:
+            self._auto_rollbacks += 1
+        self.rollback()
+        self._finish("auto_rollback", losses)
         return True
 
     def _finish(self, result: str, losses) -> None:
@@ -386,7 +668,19 @@ class OnlineTrainer:
                 "last_error": self._last_error,
                 "last_losses": self._last_losses,
                 "can_rollback": self._rollback is not None,
+                "promote_patience": self._patience,
+                "win_streak": self._wins,
+                "consumed_rows": self._consumed_rows,
+                "replayed_rows": self._replayed_rows,
+                "auto_rollbacks": self._auto_rollbacks,
+                "last_promotion_ts": self._last_promotion_ts,
+                "last_rollback_ts": self._last_rollback_ts,
+                "watch_armed": self._watch is not None,
+                "watch_rows": self._watch["rows"]
+                if self._watch is not None else 0,
             }
+        if self._store is not None:
+            st["store"] = self._store.state()
         st["buffered_rows"] = self.buffer.rows
         st["shadow_rows"] = self.buffer.shadow_rows
         st["dropped_rows"] = self.buffer.dropped_rows

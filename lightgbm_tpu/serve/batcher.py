@@ -1,31 +1,49 @@
-"""Micro-batching request coalescer for the serving path.
+"""Micro-batching request coalescer with per-tenant fair queuing.
 
-A single background worker drains a submit queue, coalescing concurrent
-``submit(X)`` calls into ONE bucketed device dispatch per batch — ensemble
-inference throughput is won by amortizing launches over large coalesced
-batches, so at batch size 1 the dominant cost is dispatch, not math. Two
-knobs bound the trade: ``max_batch_rows`` caps how much a batch grows,
-``max_wait_ms`` caps how long the first request in a batch waits for
-company.
+A single background worker drains the pending queues, coalescing
+concurrent ``submit(X)`` calls into ONE bucketed device dispatch per
+batch — ensemble inference throughput is won by amortizing launches over
+large coalesced batches, so at batch size 1 the dominant cost is
+dispatch, not math. Two knobs bound the trade: ``max_batch_rows`` caps
+how much a batch grows, ``max_wait_ms`` caps how long the first request
+in a batch waits for company.
 
 Results come back through ``concurrent.futures.Future``; a worker
 exception fails every future of its batch (callers see the real error,
-the worker keeps serving). ``close()`` drains and fails whatever is still
-queued, then joins the thread.
+the worker keeps serving). ``close()`` finishes the in-flight batch,
+fails whatever is still queued, then joins the thread.
 
-Admission control: ``max_queue_rows`` bounds how many rows may sit queued
-but undispatched. Overflow behavior is the ``overload`` policy — ``shed``
-raises :class:`QueueFullError` at submit (the HTTP layer maps it to 429,
-so overload degrades into fast rejections instead of unbounded latency),
-``block`` parks submitters until the worker drains space (per-caller
-backpressure; an upstream of bounded concurrency self-throttles).
+Multi-tenant fairness (the fleet layer): every request belongs to a
+tenant (default ``"default"``), each tenant has its own pending deque,
+and the worker picks the next request by **start-time fair queuing**:
+the active tenant with the smallest virtual time goes first, and
+dequeuing ``r`` rows advances that tenant's clock by ``r / weight`` —
+so over any backlog window tenants drain rows proportionally to their
+weights and a flooding tenant cannot starve the rest. An idle tenant's
+clock is pulled up to the global virtual clock when it becomes active
+again (no credit hoarding).
+
+Admission control, two layers:
+
+- ``max_queue_rows`` bounds TOTAL queued-but-undispatched rows (the
+  memory/latency bound).
+- ``tenant_quota_rows`` bounds each single tenant's queued rows (the
+  noisy-neighbor bound): one tenant hitting its quota sheds/blocks only
+  itself while others keep being admitted.
+
+Overflow behavior is the ``overload`` policy — ``shed`` raises
+:class:`QueueFullError` at submit (the HTTP layer maps it to 429, so
+overload degrades into fast rejections instead of unbounded latency),
+``block`` parks the submitter until the worker drains space. Per-tenant
+shed counts and queue depths are exported as ``serve/tenant/<t>/*``
+counters//gauges and via :meth:`MicroBatcher.tenant_stats` (/healthz).
 """
 from __future__ import annotations
 
-import queue
 import threading
+from collections import deque
 from concurrent.futures import Future
-from typing import Optional
+from typing import Dict, Optional
 
 import numpy as np
 
@@ -33,25 +51,45 @@ from .. import obs
 from ..obs import telemetry
 from ..obs_trace import tracer
 
-_STOP = object()
-
 OVERLOAD_POLICIES = ("shed", "block")
+
+DEFAULT_TENANT = "default"
 
 
 class QueueFullError(RuntimeError):
-    """submit() rejected because the queue holds ``max_queue_rows`` under
-    the ``shed`` overload policy (HTTP maps this to 429)."""
+    """submit() rejected because the queue holds ``max_queue_rows`` (or
+    the tenant holds ``tenant_quota_rows``) under the ``shed`` overload
+    policy (HTTP maps this to 429)."""
 
 
 class _Request:
-    __slots__ = ("X", "rows", "future", "t0", "trace_id")
+    __slots__ = ("X", "rows", "future", "t0", "trace_id", "tenant")
 
-    def __init__(self, X: np.ndarray, trace_id: Optional[int] = None) -> None:
+    def __init__(self, X: np.ndarray, trace_id: Optional[int] = None,
+                 tenant: str = DEFAULT_TENANT) -> None:
         self.X = X
         self.rows = X.shape[0]
         self.future: Future = Future()
         self.t0 = obs.monotonic()
         self.trace_id = trace_id
+        self.tenant = tenant
+
+
+class _TenantState:
+    """Per-tenant accounting, all guarded by the batcher lock."""
+
+    __slots__ = ("pending", "queued_rows", "vtime", "weight",
+                 "shed", "shed_rows", "served_rows", "served_requests")
+
+    def __init__(self, weight: float) -> None:
+        self.pending: deque = deque()
+        self.queued_rows = 0
+        self.vtime = 0.0
+        self.weight = weight
+        self.shed = 0
+        self.shed_rows = 0
+        self.served_rows = 0
+        self.served_requests = 0
 
 
 class MicroBatcher:
@@ -59,37 +97,55 @@ class MicroBatcher:
 
     ``raw_score`` applies to every request of the batcher (requests in one
     coalesced dispatch must share the output transform).
+    ``tenant_weights`` maps tenant id -> relative fair-share weight
+    (unlisted tenants weigh 1.0); ``tenant_quota_rows`` caps any single
+    tenant's queued rows (0 = no per-tenant cap).
     """
 
     def __init__(self, session, *, max_batch_rows: int = 8192,
                  max_wait_ms: float = 2.0, raw_score: bool = False,
                  latency_window: int = 2048, max_queue_rows: int = 0,
-                 overload: str = "shed") -> None:
+                 overload: str = "shed", tenant_quota_rows: int = 0,
+                 tenant_weights: Optional[Dict[str, float]] = None) -> None:
         if max_batch_rows < 1:
             raise ValueError("max_batch_rows must be >= 1")
         if max_wait_ms < 0:
             raise ValueError("max_wait_ms must be >= 0")
         if max_queue_rows < 0:
             raise ValueError("max_queue_rows must be >= 0 (0 = unbounded)")
+        if tenant_quota_rows < 0:
+            raise ValueError("tenant_quota_rows must be >= 0 (0 = no "
+                             "per-tenant cap)")
         if overload not in OVERLOAD_POLICIES:
             raise ValueError("overload must be one of %s, got %r"
                              % ("|".join(OVERLOAD_POLICIES), overload))
+        weights = dict(tenant_weights or {})
+        for t, w in weights.items():
+            if not w > 0:
+                raise ValueError("tenant weight must be > 0, got %s=%r"
+                                 % (t, w))
         self._session = session
         self._max_rows = int(max_batch_rows)
         self._max_wait = float(max_wait_ms) / 1000.0
         self._raw = bool(raw_score)
         self._max_queue_rows = int(max_queue_rows)
-        self._shed = overload == "shed"
-        self._q: "queue.SimpleQueue" = queue.SimpleQueue()
-        # one lock, three jobs: (a) makes submit's closed-check atomic
-        # with the enqueue so no request can slip in behind close()'s
-        # _STOP and hang its Future forever; (b) guards the latency
-        # histogram, which the worker feeds while callers read
-        # latency_stats(); (c) guards the queued-row accounting behind
-        # admission control. It is a Condition so block-policy submitters
-        # can park on it until the worker drains space.
+        self._tenant_quota = int(tenant_quota_rows)
+        self._overload_shed = overload == "shed"
+        self._weights = weights
+        # one lock, all jobs: (a) makes submit's closed-check atomic with
+        # the enqueue so no request can slip in after close() and hang its
+        # Future forever; (b) guards the tenant queues + fair-queuing
+        # clocks; (c) guards the latency histogram, which the worker
+        # feeds while callers read latency_stats(); (d) guards the
+        # queued-row accounting behind admission control. It is a
+        # Condition so block-policy submitters can park on it until the
+        # worker drains space, and so the worker can park on it while the
+        # queues are empty.
         self._lock = threading.Condition()
-        self._queued_rows = 0
+        self._tenants: Dict[str, _TenantState] = {}
+        self._queued_rows = 0      # total rows queued, all tenants
+        self._queued_requests = 0
+        self._vclock = 0.0         # global virtual time (last pick's start)
         # log-bucketed histogram over submit->delivery latency in ms:
         # bounded memory at any request count, exact bucket counts for
         # /metrics; also mirrored into the global registry under
@@ -102,55 +158,110 @@ class MicroBatcher:
             target=self._worker, name="lgbtpu-serve-batcher", daemon=True)
         self._thread.start()
 
+    # ---------------------------------------------------------------- tenants
+    def _tenant(self, tenant: str) -> _TenantState:
+        # lock held. A tenant re-activating after idling starts at the
+        # global virtual clock — fairness is about the backlog window,
+        # not about banking credit while away.
+        st = self._tenants.get(tenant)   # graftlint: guarded-by=_lock -- caller holds it
+        if st is None:
+            st = self._tenants[tenant] = _TenantState(  # graftlint: guarded-by=_lock
+                self._weights.get(tenant, 1.0))
+        return st
+
+    @staticmethod
+    def _metric_tenant(tenant: str) -> str:
+        return obs.safe_metric_part(tenant)
+
     # ---------------------------------------------------------------- submit
-    def submit(self, X, trace_id: Optional[int] = None) -> Future:
+    def submit(self, X, trace_id: Optional[int] = None,
+               tenant: Optional[str] = None) -> Future:
         """Queue one request; returns a Future resolving to its predictions
         (same shapes as ``PredictSession.predict``). A 1-D row is treated
         as a single-row batch. ``trace_id`` (from the http handler) links
         this request's queue/coalesce/dispatch spans to its request span
-        when span tracing is on. Raises ``RuntimeError`` once the batcher
-        is closed — atomically with close(), so a submit either lands
-        before the worker's stop marker (and gets an answer or a
+        when span tracing is on; ``tenant`` buckets it for fair queuing
+        and per-tenant admission control. Raises ``RuntimeError`` once
+        the batcher is closed — atomically with close(), so a submit
+        either lands before the close (and gets an answer or a
         deterministic 'closed' failure from the drain) or raises here; it
         never hangs.
 
-        With ``max_queue_rows`` set, an over-limit submit raises
-        :class:`QueueFullError` (shed policy) or waits for queue space
-        (block policy). A request alone bigger than the whole bound is
-        admitted when the queue is empty — it can never fit better than
-        that, so rejecting it forever would deadlock block-policy
-        callers."""
+        With ``max_queue_rows``/``tenant_quota_rows`` set, an over-limit
+        submit raises :class:`QueueFullError` (shed policy) or waits for
+        queue space (block policy). A request alone bigger than the whole
+        bound is admitted when its scope (queue / tenant queue) is empty
+        — it can never fit better than that, so rejecting it forever
+        would deadlock block-policy callers."""
         X = np.asarray(X, np.float64)
         if X.ndim == 1:
             X = X[None, :]
         if trace_id is None and tracer.serve_on:
             trace_id = tracer.new_trace_id()
-        req = _Request(X, trace_id)
+        tenant = DEFAULT_TENANT if tenant is None else str(tenant)
+        req = _Request(X, trace_id, tenant)
         with self._lock:
             if self._closed:
                 raise RuntimeError("MicroBatcher is closed")
-            if self._max_queue_rows > 0:
-                while self._queued_rows > 0 and \
-                        self._queued_rows + req.rows > self._max_queue_rows:
-                    if self._shed:
-                        telemetry.count("serve/shed")
-                        telemetry.count("serve/shed_rows", req.rows)
-                        raise QueueFullError(
-                            "queue holds %d rows; admitting %d more would "
-                            "exceed max_queue_rows=%d"
-                            % (self._queued_rows, req.rows,
-                               self._max_queue_rows))
-                    self._lock.wait()
-                    if self._closed:
-                        raise RuntimeError("MicroBatcher is closed")
+            st = self._tenant(tenant)
+            while self._over_limit(st, req.rows):
+                if self._overload_shed:
+                    st.shed += 1
+                    st.shed_rows += req.rows
+                    depth = self._queued_rows
+                    t_queued = st.queued_rows
+                    self._count_shed(tenant, req.rows)
+                    raise QueueFullError(
+                        "queue holds %d rows (%d for tenant %r); admitting "
+                        "%d more would exceed max_queue_rows=%d / "
+                        "tenant_quota_rows=%d"
+                        % (depth, t_queued, tenant, req.rows,
+                           self._max_queue_rows, self._tenant_quota))
+                self._lock.wait()
+                if self._closed:
+                    raise RuntimeError("MicroBatcher is closed")
+                st = self._tenant(tenant)
+            if not st.pending:
+                # (re-)activation: start at the global virtual clock so
+                # an idle period does not bank dequeue credit
+                st.vtime = max(st.vtime, self._vclock)
+            st.pending.append(req)
+            st.queued_rows += req.rows
             self._queued_rows += req.rows
+            self._queued_requests += 1
             depth = self._queued_rows
-            self._q.put(req)
+            n_queued = self._queued_requests
+            t_depth = st.queued_rows
+            self._lock.notify_all()
         telemetry.count("serve/requests")
         telemetry.count("serve/rows", req.rows)
-        telemetry.gauge("serve/queue_depth", self._q.qsize())
+        telemetry.gauge("serve/queue_depth", n_queued)
         telemetry.observe("serve/queue_depth_rows", depth)
+        telemetry.gauge("serve/tenant/%s/queue_rows"
+                        % self._metric_tenant(tenant), t_depth)
         return req.future
+
+    def _over_limit(self, st: _TenantState, rows: int) -> bool:
+        # lock held. The oversize carve-out is per scope: a request alone
+        # bigger than the global bound is admitted when the whole queue
+        # is empty; one bigger than its tenant quota when that tenant's
+        # queue is empty.
+        total = self._queued_rows   # graftlint: guarded-by=_lock -- caller holds it
+        mine = st.queued_rows       # graftlint: guarded-by=_lock -- caller holds it
+        if self._max_queue_rows > 0 and total > 0 \
+                and total + rows > self._max_queue_rows:
+            return True
+        if self._tenant_quota > 0 and mine > 0 \
+                and mine + rows > self._tenant_quota:
+            return True
+        return False
+
+    def _count_shed(self, tenant: str, rows: int) -> None:
+        telemetry.count("serve/shed")
+        telemetry.count("serve/shed_rows", rows)
+        telemetry.count("serve/tenant/%s/shed" % self._metric_tenant(tenant))
+        telemetry.count("serve/tenant/%s/shed_rows"
+                        % self._metric_tenant(tenant), rows)
 
     def queue_rows(self) -> int:
         """Rows submitted but not yet picked up by the worker (the
@@ -158,21 +269,57 @@ class MicroBatcher:
         with self._lock:
             return self._queued_rows
 
-    def _dequeued(self, req) -> None:
-        # a dequeued request frees its queue-space reservation; wake any
-        # block-policy submitters parked in submit()
+    def tenant_stats(self) -> Dict[str, Dict[str, float]]:
+        """Per-tenant queue/shed/served snapshot (the /healthz
+        ``tenants`` section)."""
         with self._lock:
-            self._queued_rows -= req.rows
-            self._lock.notify_all()
+            return {t: {"queue_rows": st.queued_rows,
+                        "shed": st.shed,
+                        "shed_rows": st.shed_rows,
+                        "served_requests": st.served_requests,
+                        "served_rows": st.served_rows,
+                        "weight": st.weight}
+                    for t, st in sorted(self._tenants.items())}
 
     # ---------------------------------------------------------------- worker
+    def _pick_locked(self) -> Optional[_Request]:
+        """Start-time-fair pick: the active tenant with the smallest
+        virtual time goes first; dequeuing advances its clock by
+        rows/weight. Lock held; returns None when nothing is queued."""
+        best: Optional[str] = None
+        best_v = 0.0
+        for t, st in self._tenants.items():   # graftlint: guarded-by=_lock -- caller holds it
+            if st.pending and (best is None or st.vtime < best_v
+                               or (st.vtime == best_v and t < best)):
+                best, best_v = t, st.vtime
+        if best is None:
+            return None
+        st = self._tenants[best]   # graftlint: guarded-by=_lock -- caller holds it
+        req = st.pending.popleft()
+        self._vclock = st.vtime    # graftlint: guarded-by=_lock -- caller holds it
+        st.vtime += req.rows / st.weight
+        st.queued_rows -= req.rows
+        self._queued_rows -= req.rows      # graftlint: guarded-by=_lock -- caller holds it
+        self._queued_requests -= 1         # graftlint: guarded-by=_lock -- caller holds it
+        st.served_requests += 1
+        st.served_rows += req.rows
+        # a dequeued request frees its queue-space reservation; wake any
+        # block-policy submitters parked in submit()
+        self._lock.notify_all()
+        return req
+
     def _worker(self) -> None:
-        stop = False
-        while not stop:
-            req = self._q.get()
-            if req is _STOP:
-                break
-            self._dequeued(req)
+        while True:
+            with self._lock:
+                while self._queued_requests == 0 and not self._closed:
+                    self._lock.wait()
+                if self._closed:
+                    # requests admitted before the close flag flipped are
+                    # failed deterministically — submit can no longer
+                    # enqueue behind us, so this drains everything
+                    self._drain_locked()
+                    return
+                req = self._pick_locked()
             batch = [req]
             rows = req.rows
             t_first = obs.monotonic()    # lead request leaves the queue
@@ -182,23 +329,19 @@ class MicroBatcher:
                 # never delays anyone. Only WAITING for company is bounded
                 # by the deadline; otherwise a dispatch slower than
                 # max_wait_ms degenerates every backlog into batches of 1.
-                try:
-                    nxt = self._q.get_nowait()
-                except queue.Empty:
-                    remain = deadline - obs.monotonic()
-                    if remain <= 0:
-                        break
-                    try:
-                        nxt = self._q.get(timeout=remain)
-                    except queue.Empty:
-                        break
-                if nxt is _STOP:
-                    stop = True
+                with self._lock:
+                    if self._queued_requests == 0 and not self._closed:
+                        remain = deadline - obs.monotonic()
+                        if remain > 0:
+                            self._lock.wait(remain)
+                    nxt = self._pick_locked()
+                if nxt is None:
                     break
-                self._dequeued(nxt)
                 batch.append(nxt)
                 rows += nxt.rows
-            telemetry.gauge("serve/queue_depth", self._q.qsize())
+            with self._lock:
+                depth = self._queued_requests
+            telemetry.gauge("serve/queue_depth", depth)
             if tracer.serve_on:
                 # retroactive spans: each request's time-in-queue (submit
                 # until its batch was sealed) plus one coalesce span for
@@ -211,7 +354,6 @@ class MicroBatcher:
                               trace_id=batch[0].trace_id,
                               args={"requests": len(batch), "rows": rows})
             self._run_batch(batch)
-        self._drain()
 
     def _run_batch(self, batch) -> None:
         n_rows = sum(r.rows for r in batch)
@@ -274,30 +416,28 @@ class MicroBatcher:
                 "p99_s": pcts["p99"], "p999_s": pcts["p999"]}
 
     # -------------------------------------------------------------- shutdown
-    def _drain(self) -> None:
+    def _drain_locked(self) -> None:
+        # lock held; fail every still-queued future so no caller hangs on
+        # a stopped worker
         while True:
-            try:
-                r = self._q.get_nowait()
-            except queue.Empty:
+            req = self._pick_locked()
+            if req is None:
                 return
-            if r is _STOP:
-                continue
-            self._dequeued(r)
-            if not r.future.done():
-                r.future.set_exception(RuntimeError("MicroBatcher closed"))
+            if not req.future.done():
+                req.future.set_exception(RuntimeError("MicroBatcher closed"))
 
     def close(self, timeout: Optional[float] = None) -> None:
         """Stop accepting requests, finish the in-flight batch, fail any
-        still-queued futures, join the worker. Idempotent. The flag flip
-        and the stop marker go in under the submit lock, so every request
-        that beat the flip sits ahead of _STOP and gets drained;
-        block-policy submitters parked for queue space are woken and
-        raise instead of hanging on a dead worker."""
+        still-queued futures, join the worker. Idempotent. The flag flips
+        under the submit lock, so every request that beat the flip is
+        either dispatched with the in-flight batch or failed
+        deterministically by the worker's drain; block-policy submitters
+        parked for queue space are woken and raise instead of hanging on
+        a dead worker."""
         with self._lock:
             if self._closed:
                 return
             self._closed = True
-            self._q.put(_STOP)
             self._lock.notify_all()
         self._thread.join(timeout)
 

@@ -74,6 +74,7 @@ class PredictServer:
                  raw_score: bool = False, warmup: bool = True,
                  request_timeout_s: float = 30.0,
                  max_queue_rows: int = 0, overload: str = "shed",
+                 tenant_quota_rows: int = 0, tenant_weights=None,
                  online=None) -> None:
         from ..online.registry import ModelRegistry
 
@@ -86,7 +87,10 @@ class PredictServer:
                               max_batch_rows=max_batch_rows,
                               max_wait_ms=max_wait_ms,
                               max_queue_rows=max_queue_rows,
-                              overload=overload, raw_score=raw_score,
+                              overload=overload,
+                              tenant_quota_rows=tenant_quota_rows,
+                              tenant_weights=tenant_weights,
+                              raw_score=raw_score,
                               warmup=warmup, online=online)
         elif model is not None or online is not None:
             raise LightGBMError(
@@ -94,6 +98,9 @@ class PredictServer:
                 "not both")
         self.registry = registry
         self.request_timeout_s = float(request_timeout_s)
+        # fleet replica mode: the CLI attaches the ReplicaWatcher here so
+        # /healthz reports applied version/swaps and close() stops it
+        self.fleet_watcher = None
         self._started_at = obs.monotonic()
         # guards the draining flag: flipped by begin_shutdown (signal
         # helper thread) and read on every handler thread
@@ -164,11 +171,17 @@ class PredictServer:
                     X = np.asarray(payload["rows"], np.float64)
                     if X.ndim == 1:
                         X = X[None, :]
+                    # tenant for fair queuing + per-tenant admission:
+                    # header wins (proxies inject it), body is the
+                    # curl-friendly fallback, absent means "default"
+                    tenant = self.headers.get("X-Tenant") \
+                        or payload.get("tenant")
                     tid = tracer.new_trace_id() if tracer.serve_on else None
                     with tracer.span("serve/http_request", domain="serve",
                                      trace_id=tid, rows=int(X.shape[0]),
                                      model=entry.model_id):
-                        fut = entry.batcher.submit(X, trace_id=tid)
+                        fut = entry.batcher.submit(X, trace_id=tid,
+                                                   tenant=tenant)
                         out = fut.result(timeout=server.request_timeout_s)
                     self._json(200, {"predictions": out.tolist(),
                                      "rows": int(X.shape[0]),
@@ -228,17 +241,40 @@ class PredictServer:
 
     def healthz(self) -> dict:
         """The /healthz document: substance, not a static OK — model
-        versions, registry size, queue depth, uptime and online-trainer
-        state per model."""
+        versions, registry size, queue depth, per-tenant queue/shed
+        counts, uptime, online-trainer state per model (including
+        last-promotion/rollback timestamps) and — in fleet replica mode
+        — the watcher's applied version."""
         models = self.registry.info()
+        # fleet ops view: per-tenant depth/sheds merged across models,
+        # and each model's promotion/rollback timestamps hoisted out of
+        # the nested online state
+        tenants: dict = {}
+        for m in models.values():
+            for t, st in (m.get("tenants") or {}).items():
+                agg = tenants.setdefault(
+                    t, {"queue_rows": 0, "shed": 0, "shed_rows": 0})
+                agg["queue_rows"] += st.get("queue_rows", 0)
+                agg["shed"] += st.get("shed", 0)
+                agg["shed_rows"] += st.get("shed_rows", 0)
+        promotions = {
+            mid: {"last_promotion_ts": m["online"]["last_promotion_ts"],
+                  "last_rollback_ts": m["online"]["last_rollback_ts"]}
+            for mid, m in models.items()
+            if m.get("online") and "last_promotion_ts" in m["online"]}
         doc = {
             "status": "draining" if self.draining() else "ok",
             "uptime_s": round(obs.monotonic() - self._started_at, 3),
             "model_count": len(self.registry),
             "models": models,
             "queue_rows": sum(m["queue_rows"] for m in models.values()),
+            "tenants": tenants,
             "requests": telemetry.counter("serve/requests"),
         }
+        if promotions:
+            doc["promotions"] = promotions
+        if self.fleet_watcher is not None:
+            doc["fleet"] = self.fleet_watcher.state()
         try:
             from .. import obs_device
             # compact device-cost view: HBM watermark + capture totals
@@ -291,4 +327,6 @@ class PredictServer:
         try:
             self.httpd.server_close()
         finally:
+            if self.fleet_watcher is not None:
+                self.fleet_watcher.close()
             self.registry.close()

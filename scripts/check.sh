@@ -4,6 +4,8 @@
 #   check.sh            lint (full repo) + lint tests + the fast
 #                       serve/online/obs tier-1 subset  (~1 min CPU)
 #   check.sh --fast     lint only files changed vs git + lint tests
+#   check.sh --fleet    lint + lint tests + the fleet/online/serve fast
+#                       subset (the durability/fairness/rollback layer)
 #   check.sh --slo      everything above, plus the closed-loop serving
 #                       SLO bench gated against SLO_BASELINE.json
 #   check.sh --ledger   everything above, plus the run-ledger regression
@@ -15,10 +17,12 @@ cd "$(dirname "$0")/.."
 
 LINT_ARGS=""
 RUN_SUBSET=1
+RUN_FLEET=0
 RUN_SLO=0
 RUN_LEDGER=0
 case "$1" in
     --fast)   LINT_ARGS="--changed"; RUN_SUBSET=0 ;;
+    --fleet)  RUN_SUBSET=0; RUN_FLEET=1 ;;
     --slo)    RUN_SLO=1 ;;
     --ledger) RUN_LEDGER=1 ;;
 esac
@@ -35,6 +39,12 @@ if [ "$RUN_SUBSET" = 1 ]; then
         tests/test_serve.py tests/test_online.py \
         tests/test_obs.py tests/test_trace.py \
         tests/test_linear_device.py
+fi
+
+if [ "$RUN_FLEET" = 1 ]; then
+    echo "== fleet/online/serve fast tests =="
+    JAX_PLATFORMS=cpu python -m pytest -q -m 'not slow' \
+        tests/test_fleet.py tests/test_online.py tests/test_serve.py
 fi
 
 if [ "$RUN_SLO" = 1 ]; then

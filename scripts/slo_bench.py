@@ -3,9 +3,22 @@
 Usage:
     python scripts/slo_bench.py --quick                # CPU-sized run
     python scripts/slo_bench.py --quick --online       # + live refit loop
+    python scripts/slo_bench.py --quick --fleet        # trainer + 2 replicas
+    python scripts/slo_bench.py --quick --noisy-tenant # fairness demo
     python scripts/slo_bench.py --baseline SLO_BASELINE.json
     python scripts/slo_bench.py --against SLO_BASELINE.json
     python scripts/slo_bench.py --p99-target-ms 50
+
+``--fleet`` runs the PR-11 fleet e2e under closed-loop load: one trainer
+publishes promotions through a durable FleetStore while TWO serving
+replicas (own boosters, own HTTP servers) watch it and hot-swap; the
+gate checks both replicas converge to the published version with exactly
+one whole-model version bump per applied publish.
+
+``--noisy-tenant`` measures per-tenant fairness: a quota-respecting
+tenant's client-side p99 is taken solo, then again while a flooding
+tenant saturates its own quota; the gate fails when the polite tenant is
+shed at all or pushed past ``--fair-p99-factor`` x its solo p99.
 
 Closed loop: N client threads POST /predict against an in-process
 ``PredictServer`` on an ephemeral port, each sending its next request
@@ -35,22 +48,267 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 
-def _client(base, n, rows, payload, fails, sheds):
+def _client(base, n, rows, payload, fails, sheds, tenant=None, lat=None):
     from urllib.error import HTTPError
     from urllib.request import Request, urlopen
 
+    headers = {"Content-Type": "application/json"}
+    if tenant:
+        headers["X-Tenant"] = tenant
     for _ in range(n):
-        req = Request(base + "/predict", data=payload,
-                      headers={"Content-Type": "application/json"})
+        req = Request(base + "/predict", data=payload, headers=headers)
+        t0 = time.perf_counter()  # graftlint: disable=naked-timer -- client-side latency clock, measures the server
         try:
             with urlopen(req, timeout=60) as resp:
                 out = json.loads(resp.read())
                 if len(out["predictions"]) != rows:
                     fails.append("short response")
+                elif lat is not None:
+                    lat.append((time.perf_counter() - t0) * 1000.0)  # graftlint: disable=naked-timer -- client-side latency clock
         except HTTPError as exc:
             (sheds if exc.code == 429 else fails).append(exc.code)
         except Exception as exc:  # noqa: BLE001 - benchmark accounting
             fails.append(repr(exc))
+
+
+def _train_seed(preset):
+    import numpy as np
+
+    import lightgbm_tpu as lgb
+
+    rng = np.random.RandomState(0)
+    w = rng.randn(preset["features"])
+    X = rng.randn(preset["train_rows"], preset["features"])
+    y = (X @ w + 0.2 * rng.randn(len(X)) > 0).astype(np.float64)
+    bst = lgb.train({"objective": "binary", "verbosity": -1,
+                     "num_leaves": preset["leaves"]},
+                    lgb.Dataset(X, label=y),
+                    num_boost_round=preset["trees"])
+    return bst, rng, w
+
+
+def _preset(args):
+    if args.quick:
+        return dict(train_rows=2000, trees=20, leaves=15, features=10,
+                    clients=4, requests=240)
+    return dict(train_rows=20000, trees=100, leaves=31, features=20,
+                clients=8, requests=2000)
+
+
+def _run_fleet(args) -> int:
+    """Trainer + two serving replicas over one durable store, closed-loop
+    load on both replicas, convergence + whole-model gates."""
+    import tempfile
+
+    from lightgbm_tpu import obs
+    from lightgbm_tpu.fleet import FleetStore, ReplicaWatcher, \
+        bootstrap_model
+    from lightgbm_tpu.online import OnlineTrainer
+    from lightgbm_tpu.serve import PredictServer
+
+    preset = _preset(args)
+    clients = args.clients or preset["clients"]
+    total = args.requests or preset["requests"]
+    rows = args.rows_per_request
+    bst, rng, w = _train_seed(preset)
+
+    tmp = tempfile.mkdtemp(prefix="lgbtpu_fleet_bench_")
+    store = FleetStore(tmp, "default")
+    store.publish(bst.model_to_string(), event="boot")
+
+    # the trainer process: ingests labeled traffic, publishes promotions
+    trainer = OnlineTrainer(bst, trigger_rows=max(256, rows * 8),
+                            min_rows=128, shadow_rows=1024, store=store)
+    # two serving replicas, each with a PRIVATE booster bootstrapped from
+    # the store and a watcher hot-swapping newer publishes into it
+    replicas = []
+    for i in range(2):
+        rb, applied = bootstrap_model(store)
+        server = PredictServer(rb, port=0, buckets=(64, 256), warmup=True,
+                               max_wait_ms=2.0)
+        server.fleet_watcher = ReplicaWatcher(
+            rb, store, poll_interval_s=0.1, applied_version=applied)
+        th = threading.Thread(target=server.serve_forever,
+                              name="slo-fleet-replica%d" % i, daemon=True)
+        th.start()
+        host, port = server.address
+        replicas.append({"server": server, "thread": th, "booster": rb,
+                         "base": "http://%s:%d" % (host, port),
+                         "v0": rb.inner.model_version})
+
+    stop_ingest = threading.Event()
+
+    def ingest_loop():
+        while not stop_ingest.is_set():
+            Xi = rng.randn(64, preset["features"])
+            yi = (Xi @ w > 0).astype("float64")
+            try:
+                trainer.ingest(Xi, yi)
+            except Exception:  # noqa: BLE001 - keep feeding
+                pass
+            time.sleep(0.02)
+
+    ingester = threading.Thread(target=ingest_loop,
+                                name="slo-fleet-ingest", daemon=True)
+    ingester.start()
+
+    fails, sheds = [], []
+    threads = [threading.Thread(
+        target=_client, name="slo-fleet-c%d" % i,
+        args=(replicas[i % 2]["base"], total // clients, rows,
+              json.dumps({"rows": rng.randn(
+                  rows, preset["features"]).tolist()}).encode(),
+              fails, sheds))
+        for i in range(clients)]
+    t0 = obs.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = obs.monotonic() - t0
+
+    # grace window: a promotion must land and BOTH replicas converge on it
+    deadline = obs.monotonic() + (30 if args.quick else 60)
+    converged = False
+    while obs.monotonic() < deadline:
+        published = store.state()["last_published_version"]
+        if trainer.state()["promotions"] >= 1 and all(
+                r["server"].fleet_watcher.applied_version == published
+                for r in replicas):
+            converged = True
+            break
+        time.sleep(0.1)
+    stop_ingest.set()
+    ingester.join(timeout=30)
+    trainer.close()
+    published = store.state()["last_published_version"]
+
+    rep_docs = []
+    bumps_ok = True
+    for r in replicas:
+        st = r["server"].fleet_watcher.state()
+        bumps = r["booster"].inner.model_version - r["v0"]
+        # whole-model invariant: every applied publish is exactly ONE
+        # atomic adopt — version bumps match swap count
+        bumps_ok = bumps_ok and bumps == st["swaps"]
+        rep_docs.append({"applied_version": st["applied_version"],
+                         "swaps": st["swaps"],
+                         "version_bumps": bumps})
+        r["server"].shutdown()
+        r["thread"].join(timeout=30)
+        r["server"].close()
+
+    tstate = trainer.state()
+    result = {
+        "bench": "slo_fleet",
+        "quick": bool(args.quick),
+        "elapsed_s": round(elapsed, 3),
+        "published_version": published,
+        "promotions": tstate["promotions"],
+        "rejections": tstate["rejections"],
+        "replicas": rep_docs,
+        "store_dir": tmp,
+        "errors": fails[:5],
+    }
+    gate_msgs = []
+    if fails:
+        gate_msgs.append("%d request failures" % len(fails))
+    if tstate["promotions"] < 1:
+        gate_msgs.append("no promotion landed within the grace window")
+    if not converged:
+        gate_msgs.append("replicas did not converge to v%d" % published)
+    if not bumps_ok:
+        gate_msgs.append("version bumps != applied swaps (torn swap?)")
+    result["pass"] = not gate_msgs
+    if gate_msgs:
+        result["gate_failures"] = gate_msgs
+    print(json.dumps(result))
+    return 0 if result["pass"] else 1
+
+
+def _run_noisy_tenant(args) -> int:
+    """Fairness demo/gate: a flooding tenant saturates its quota while a
+    quota-respecting tenant keeps its solo latency profile."""
+    import numpy as np
+
+    from lightgbm_tpu.serve import PredictServer
+
+    preset = _preset(args)
+    rows = args.rows_per_request
+    bst, rng, _ = _train_seed(preset)
+    server = PredictServer(bst, port=0, buckets=(64, 256), warmup=True,
+                           max_wait_ms=2.0,
+                           max_queue_rows=8192,
+                           tenant_quota_rows=512)
+    host, port = server.address
+    base = "http://%s:%d" % (host, port)
+    th = threading.Thread(target=server.serve_forever,
+                          name="slo-noisy-serve", daemon=True)
+    th.start()
+
+    payload = json.dumps(
+        {"rows": rng.randn(rows, preset["features"]).tolist()}).encode()
+    big = json.dumps(
+        {"rows": rng.randn(64, preset["features"]).tolist()}).encode()
+    n_polite = 120 if args.quick else 500
+
+    # phase 1: the polite tenant alone — its fair-share latency profile
+    fails, p_sheds, lat_solo = [], [], []
+    _client(base, n_polite, rows, payload, fails, p_sheds,
+            tenant="polite", lat=lat_solo)
+
+    # phase 2: same workload while a flooding tenant slams its quota
+    stop_flood = threading.Event()
+    n_sheds = []
+
+    def flood():
+        n_fails = []
+        while not stop_flood.is_set():
+            _client(base, 4, 64, big, n_fails, n_sheds, tenant="noisy")
+
+    flooders = [threading.Thread(target=flood, name="slo-noisy-f%d" % i,
+                                 daemon=True) for i in range(2)]
+    for f in flooders:
+        f.start()
+    lat_cont = []
+    _client(base, n_polite, rows, payload, fails, p_sheds,
+            tenant="polite", lat=lat_cont)
+    stop_flood.set()
+    for f in flooders:
+        f.join(timeout=30)
+    stats = server.registry.get().batcher.tenant_stats()
+    server.shutdown()
+    th.join(timeout=30)
+    server.close()
+
+    p99_solo = float(np.percentile(lat_solo, 99)) if lat_solo else 0.0
+    p99_cont = float(np.percentile(lat_cont, 99)) if lat_cont else 0.0
+    result = {
+        "bench": "slo_noisy_tenant",
+        "quick": bool(args.quick),
+        "polite_requests": n_polite * 2,
+        "polite_p99_solo_ms": round(p99_solo, 3),
+        "polite_p99_contended_ms": round(p99_cont, 3),
+        "polite_429": len(p_sheds),
+        "noisy_429": len(n_sheds),
+        "fair_p99_factor": args.fair_p99_factor,
+        "tenants": stats,
+        "errors": fails[:5],
+    }
+    gate_msgs = []
+    if fails:
+        gate_msgs.append("%d request failures" % len(fails))
+    if p_sheds:
+        gate_msgs.append("polite tenant was shed %d times (quota must "
+                         "only bite the flooder)" % len(p_sheds))
+    if p99_solo > 0 and p99_cont > p99_solo * args.fair_p99_factor:
+        gate_msgs.append("polite p99 %.2fms > %.1fx solo %.2fms"
+                         % (p99_cont, args.fair_p99_factor, p99_solo))
+    result["pass"] = not gate_msgs
+    if gate_msgs:
+        result["gate_failures"] = gate_msgs
+    print(json.dumps(result))
+    return 0 if result["pass"] else 1
 
 
 def main(argv=None) -> int:
@@ -66,6 +324,15 @@ def main(argv=None) -> int:
     ap.add_argument("--online", action="store_true",
                     help="run a live refit/promotion loop during the "
                          "measurement window")
+    ap.add_argument("--fleet", action="store_true",
+                    help="fleet e2e: trainer publishing through a durable "
+                         "store, two hot-swapping serving replicas")
+    ap.add_argument("--noisy-tenant", action="store_true",
+                    help="per-tenant fairness gate: flooding tenant vs "
+                         "quota-respecting tenant")
+    ap.add_argument("--fair-p99-factor", type=float, default=8.0,
+                    help="--noisy-tenant bound: contended polite p99 must "
+                         "stay within this factor of its solo p99")
     ap.add_argument("--max-queue-rows", type=int, default=0)
     ap.add_argument("--p99-target-ms", type=float, default=None,
                     help="absolute gate: exit 1 when p99 exceeds this")
@@ -79,31 +346,22 @@ def main(argv=None) -> int:
                          "a regression gate, not a jitter trap)")
     args = ap.parse_args(argv)
 
+    if args.fleet:
+        return _run_fleet(args)
+    if args.noisy_tenant:
+        return _run_noisy_tenant(args)
+
     import numpy as np
 
-    import lightgbm_tpu as lgb
     from lightgbm_tpu import obs
     from lightgbm_tpu.obs import telemetry
     from lightgbm_tpu.serve import PredictServer
 
-    if args.quick:
-        preset = dict(train_rows=2000, trees=20, leaves=15, features=10,
-                      clients=4, requests=240)
-    else:
-        preset = dict(train_rows=20000, trees=100, leaves=31, features=20,
-                      clients=8, requests=2000)
+    preset = _preset(args)
     clients = args.clients or preset["clients"]
     total = args.requests or preset["requests"]
     rows = args.rows_per_request
-
-    rng = np.random.RandomState(0)
-    w = rng.randn(preset["features"])
-    X = rng.randn(preset["train_rows"], preset["features"])
-    y = (X @ w + 0.2 * rng.randn(len(X)) > 0).astype(np.float64)
-    bst = lgb.train({"objective": "binary", "verbosity": -1,
-                     "num_leaves": preset["leaves"]},
-                    lgb.Dataset(X, label=y),
-                    num_boost_round=preset["trees"])
+    bst, rng, w = _train_seed(preset)
 
     online = dict(trigger_rows=max(256, rows * 8), min_rows=128,
                   shadow_rows=1024) if args.online else None

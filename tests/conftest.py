@@ -22,6 +22,35 @@ if "xla_force_host_platform_device_count" not in _flags:
 # persistent compile cache: the jitted tree builder dominates test wall-clock
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/lgb_tpu_jax_cache")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+# jaxlib 0.4.37's CPU backend intermittently segfaults/aborts when
+# DESERIALIZING tiny cached executables (trivial jit_add/broadcast-class
+# programs; reproducible ~1-in-2 once such entries exist). Only the big
+# block programs (>~140 KB serialized) are worth caching anyway, so gate
+# writes on entry size — and sweep undersized entries that earlier runs
+# already wrote, or every later suite run rolls the same dice.
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "65536")
+
+
+def _sweep_small_cache_entries() -> None:
+    d = os.environ["JAX_COMPILATION_CACHE_DIR"]
+    floor = int(os.environ["JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES"])
+    if not os.path.isdir(d):
+        return
+    for name in os.listdir(d):
+        if not name.endswith("-cache"):
+            continue
+        path = os.path.join(d, name)
+        try:
+            if os.path.getsize(path) < floor:
+                os.unlink(path)
+                atime = os.path.join(d, name[:-len("-cache")] + "-atime")
+                if os.path.exists(atime):
+                    os.unlink(atime)
+        except OSError:
+            pass  # concurrent suite run; the survivor sweeps next time
+
+
+_sweep_small_cache_entries()
 
 import jax  # noqa: E402
 
