@@ -335,14 +335,22 @@ class GBDT:
             except Exception:
                 spec = None
             root_hists = 0 if (spec and spec["work_layout"] != "rows") else 1
+            one_kernel = bool(spec and spec.get("split_kernel") == "on")
+            # one-kernel split: the fused launch IS the partition launch;
+            # per-split histogram and split-scan launches disappear
             telemetry.count("learner/partition_launches", splits)
-            telemetry.count("learner/hist_launches", splits + root_hists)
+            telemetry.count("learner/hist_launches",
+                            root_hists if one_kernel else splits + root_hists)
+            telemetry.count("learner/scan_launches",
+                            0 if one_kernel else splits)
             if spec:
                 telemetry.gauge("traffic/work_layout", spec["work_layout"])
                 telemetry.gauge("traffic/partition_bytes_per_row",
                                 spec["partition_bytes_per_row"])
                 telemetry.gauge("traffic/hist_bytes_per_row",
                                 spec["hist_bytes_per_row"])
+                telemetry.gauge("learner/launches_per_split",
+                                spec.get("launches_per_split", 3))
             if tree.num_leaves > 1:
                 any_nonconstant = True
         if self.config.obs_check_finite != "off":

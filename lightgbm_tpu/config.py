@@ -342,6 +342,14 @@ class Config:
     #   grows bit-identical trees. auto: on when the resolved layout is
     #   planes on a TPU backend; on: force (requires a planes-capable
     #   config — errors with tpu_work_layout=rows or int8 histograms).
+    tpu_split_kernel: str = "auto"   # auto|off|on: one-kernel split — ONE
+    #   pallas_call per split running partition + smaller-child histogram
+    #   + split scan as sequential phases (planes/resident layouts only),
+    #   vs the three-launch chain. Bit-identical trees; the three-launch
+    #   path stays as the parity oracle. auto: off everywhere until the
+    #   fused kernel is validated on real Mosaic (scripts/split_bisect.py);
+    #   on: force where structurally eligible (serial training, planes
+    #   family, no feature bundling / CEGB / intermediate monotone).
     use_quantized_grad: bool = False  # int8 stochastic gradient quantization
     #   (LightGBM 4.x quantized training analog; rows per leaf <= ~16M)
 
@@ -403,6 +411,9 @@ class Config:
         if self.tpu_resident_state not in ("auto", "off", "on"):
             Log.fatal("tpu_resident_state must be auto, off or on; got %s",
                       self.tpu_resident_state)
+        if self.tpu_split_kernel not in ("auto", "off", "on"):
+            Log.fatal("tpu_split_kernel must be auto, off or on; got %s",
+                      self.tpu_split_kernel)
         if not 0 <= self.serve_port <= 65535:
             Log.fatal("serve_port must be in [0, 65535], got %d",
                       self.serve_port)

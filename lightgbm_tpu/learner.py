@@ -672,6 +672,10 @@ def build_tree_partitioned(
     forced: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None,
     part_kernel: str = "xla",  # xla | pallas (fused DMA kernel, TPU only)
     hist_kernel: str = "xla",  # xla (einsum) | pallas (in-VMEM, TPU only)
+    split_kernel: str = "off",  # off (three launches: partition, child
+    # histogram, split scan) | on (ONE pallas_call per split running all
+    # three phases; planes family + serial training only — bit-identical
+    # trees, the off path is the parity oracle)
     work_buf: Optional[jax.Array] = None,  # carried (2, Npad, W) u8 buffer
     return_work: bool = False,
     bins_t: Optional[jax.Array] = None,    # (F, N) transposed bins — pass a
@@ -705,7 +709,8 @@ def build_tree_partitioned(
                                 hist16_segment_q, hist16_segment_resident,
                                 hist_pallas_segment,
                                 hist_pallas_segment_planes)
-    from .ops.partition import (pack_planes_fold_root,
+    from .ops.partition import (one_kernel_split_planes,
+                                pack_planes_fold_root,
                                 pack_resident_fold_root, pack_rows,
                                 pack_rows_quantized, partition_segment,
                                 partition_segment_fused,
@@ -725,6 +730,35 @@ def build_tree_partitioned(
     guard, buf_width = work_spec(num_grp, quantized, part_kernel,
                                  part_chunk, hist_chunk, layout=work_layout)
     bm = num_bin_hist if num_bin_hist is not None else num_bin
+    one_kernel = split_kernel == "on"
+    if one_kernel:
+        # the fused kernel inlines the scan verbatim under these premises
+        # (serial comm => hist/sync_split identity; bundle None => group ==
+        # feature and route_table identity; no CEGB / by-node RNG /
+        # extra-trees / constraint sets => best_raw reduces to a plain
+        # find_best_split over fmask_search; scalar monotone bounds only)
+        bad = []
+        if not planes or not fused_part:
+            bad.append("needs the fused pallas planes/resident layout")
+        if quantized:
+            bad.append("int8 histograms unsupported")
+        if bundle is not None or bm != num_bin:
+            bad.append("EFB feature bundling unsupported")
+        if comm.axis is not None:
+            bad.append("multi-device comm unsupported")
+        if hp.use_cegb:
+            bad.append("CEGB penalties unsupported")
+        if hp.has_monotone and (hp.mono_intermediate or hp.mono_advanced):
+            bad.append("intermediate/advanced monotone unsupported")
+        if feature_fraction_bynode < 1.0 or extra_trees:
+            bad.append("by-node sampling / extra-trees unsupported")
+        if constraint_sets is not None:
+            bad.append("interaction constraint sets unsupported")
+        if hist_chunk % 128:
+            bad.append("hist_chunk must be a multiple of 128")
+        if bad:
+            raise ValueError("tpu_split_kernel=on is not eligible here: "
+                             + "; ".join(bad))
 
     # ---- packed ping-pong working buffers with guard rows ----
     # the matrix columns are EFB bundles (== features when no bundling)
@@ -1136,9 +1170,15 @@ def build_tree_partitioned(
         parity = leaf_parity[leaf]
         split_col = bundle["group"][info.feature] if bundle is not None \
             else info.feature
-        with trace_phase("lgbtpu/partition"):
-            work, lt = part_fn(work, parity, start, cnt, split_col,
-                               route_table(info), ch=part_chunk)
+        # smaller child by GLOBAL in-bag count, so all shards agree
+        # (serial_tree_learner.cpp:418) — known BEFORE the partition runs,
+        # which is what lets the one-kernel path histogram the right child
+        # inside the same launch
+        left_smaller = info.left_sum[2] <= info.right_sum[2]
+        if not one_kernel:
+            with trace_phase("lgbtpu/partition"):
+                work, lt = part_fn(work, parity, start, cnt, split_col,
+                                   route_table(info), ch=part_chunk)
         new_parity = 1 - parity
 
         # ---- record ----
@@ -1162,12 +1202,18 @@ def build_tree_partitioned(
         )
 
         # ---- segment bookkeeping ----
-        leaf_start = leaf_start.at[new_leaf].set(
-            sel(start + lt, leaf_start[new_leaf]))
-        leaf_cnt = leaf_cnt.at[leaf].set(sel(lt, cnt)) \
-                           .at[new_leaf].set(sel(cnt - lt, leaf_cnt[new_leaf]))
-        leaf_parity = leaf_parity.at[leaf].set(sel(new_parity, parity)) \
-            .at[new_leaf].set(sel(new_parity, leaf_parity[new_leaf]))
+        def seg_update(lt, leaf_start, leaf_cnt, leaf_parity):
+            leaf_start = leaf_start.at[new_leaf].set(
+                sel(start + lt, leaf_start[new_leaf]))
+            leaf_cnt = leaf_cnt.at[leaf].set(sel(lt, cnt)) \
+                .at[new_leaf].set(sel(cnt - lt, leaf_cnt[new_leaf]))
+            leaf_parity = leaf_parity.at[leaf].set(sel(new_parity, parity)) \
+                .at[new_leaf].set(sel(new_parity, leaf_parity[new_leaf]))
+            return leaf_start, leaf_cnt, leaf_parity
+
+        if not one_kernel:
+            leaf_start, leaf_cnt, leaf_parity = seg_update(
+                lt, leaf_start, leaf_cnt, leaf_parity)
 
         # ---- stats bookkeeping ----
         leaf_sum = leaf_sum.at[leaf].set(sel(info.left_sum, leaf_sum[leaf])) \
@@ -1230,27 +1276,49 @@ def build_tree_partitioned(
             leaf_upper = leaf_upper.at[leaf].set(sel(new_up_l, up_l)) \
                 .at[new_leaf].set(sel(new_up_r, leaf_upper[new_leaf]))
 
-        # ---- histograms: the smaller child (by GLOBAL in-bag count, so all
-        # shards agree) gets a fresh pass over its contiguous segment; the
-        # larger child is parent - smaller (serial_tree_learner.cpp:418) ----
-        left_smaller = info.left_sum[2] <= info.right_sum[2]
-        small_start = jnp.where(left_smaller, start, start + lt)
-        small_cnt = jnp.where(left_smaller, lt, cnt - lt)
-        with trace_phase("lgbtpu/histogram"):
-            hist_small, work = hist_of(work, new_parity, small_start,
-                                       small_cnt)
+        # ---- histograms: the smaller child gets a fresh pass over its
+        # contiguous segment; the larger child is parent - smaller ----
         parent_hist = hist_pool[leaf].reshape(num_grp, bm, 3)
-        hist_large = parent_hist - hist_small
-        hist_left = jnp.where(left_smaller, hist_small, hist_large)
-        hist_right = jnp.where(left_smaller, hist_large, hist_small)
-        pool_idx = jnp.stack([leaf, new_leaf])
+        pair = jnp.stack([leaf, new_leaf])
+        if one_kernel:
+            # ONE launch: partition + smaller-child histogram + both-child
+            # split scan. Inputs match what the oracle's hist_of +
+            # node_best_pair would see (bounds/outputs already updated
+            # above); outputs are bit-identical by construction.
+            if resident:
+                work = write_route_plane(work, bins_res, parity, start, cnt,
+                                         split_col, ch=part_chunk)
+            with trace_phase("lgbtpu/one_kernel_split"):
+                work, lt, hist_left, hist_right, infos = \
+                    one_kernel_split_planes(
+                        work, parity, start, cnt,
+                        jnp.int32(0) if resident else split_col,
+                        info.go_left, left_smaller, d, parent_hist, meta,
+                        fmask_search,
+                        jnp.stack([info.left_sum, info.right_sum]),
+                        leaf_out[pair], leaf_lower[pair], leaf_upper[pair],
+                        hp, num_bins=bm, num_feat=num_grp,
+                        exact=hist_mode != "bf16", ch=part_chunk,
+                        hist_chunk=hist_chunk, lo_w=hist_lo,
+                        resident_planes=bins_res if resident else None)
+            leaf_start, leaf_cnt, leaf_parity = seg_update(
+                lt, leaf_start, leaf_cnt, leaf_parity)
+        else:
+            small_start = jnp.where(left_smaller, start, start + lt)
+            small_cnt = jnp.where(left_smaller, lt, cnt - lt)
+            with trace_phase("lgbtpu/histogram"):
+                hist_small, work = hist_of(work, new_parity, small_start,
+                                           small_cnt)
+            hist_large = parent_hist - hist_small
+            hist_left = jnp.where(left_smaller, hist_small, hist_large)
+            hist_right = jnp.where(left_smaller, hist_large, hist_small)
         if n_forced:
             old_right = hist_pool[new_leaf].reshape(num_grp, bm, 3)
             pool_val = jnp.stack([sel(hist_left, parent_hist),
                                   sel(hist_right, old_right)])
         else:
             pool_val = jnp.stack([hist_left, hist_right])
-        hist_pool = hist_pool.at[pool_idx].set(pool_val.reshape(2, -1))
+        hist_pool = hist_pool.at[pair].set(pool_val.reshape(2, -1))
         # local (g,h,cnt) totals per child (voting mode votes with these;
         # any group's bins partition the rows, so group 0 sums the leaf)
         loc_parent = leaf_sum_loc[leaf]
@@ -1268,21 +1336,23 @@ def build_tree_partitioned(
 
         # one vmapped search over both children: the scan ops are tiny at
         # (F, B), so two separate calls pay the per-op dispatch cost twice
-        pair = jnp.stack([leaf, new_leaf])
-        extra_pair = ()
-        if hp.mono_advanced:
-            adv = _adv_commit(adv, meta, sel, leaf, new_leaf, info, num_bin)
-            ab_l = _adv_bounds_of(adv, leaf)
-            ab_r = _adv_bounds_of(adv, new_leaf)
-            extra_pair = (jax.tree.map(lambda a, b: jnp.stack([a, b]),
-                                       ab_l, ab_r),)
-        with trace_phase("lgbtpu/split_scan"):
-            infos = node_best_pair(
-                r, pair, jnp.stack([hist_left, hist_right]),
-                jnp.stack([info.left_sum, info.right_sum]),
-                jnp.stack([loc_left, loc_right]), leaf_out[pair],
-                leaf_lower[pair], leaf_upper[pair], used_new, tree_used, d,
-                *extra_pair)
+        # (one-kernel rounds already scanned inside the fused launch)
+        if not one_kernel:
+            extra_pair = ()
+            if hp.mono_advanced:
+                adv = _adv_commit(adv, meta, sel, leaf, new_leaf, info,
+                                  num_bin)
+                ab_l = _adv_bounds_of(adv, leaf)
+                ab_r = _adv_bounds_of(adv, new_leaf)
+                extra_pair = (jax.tree.map(lambda a, b: jnp.stack([a, b]),
+                                           ab_l, ab_r),)
+            with trace_phase("lgbtpu/split_scan"):
+                infos = node_best_pair(
+                    r, pair, jnp.stack([hist_left, hist_right]),
+                    jnp.stack([info.left_sum, info.right_sum]),
+                    jnp.stack([loc_left, loc_right]), leaf_out[pair],
+                    leaf_lower[pair], leaf_upper[pair], used_new, tree_used,
+                    d, *extra_pair)
         gates = jnp.stack([depth_ok(leaf_depth[leaf]),
                            depth_ok(leaf_depth[new_leaf])]) & valid
         infos = infos._replace(gain=jnp.where(gates, infos.gain, -jnp.inf))
@@ -1605,7 +1675,8 @@ class SerialTreeLearner:
                 valid = {"tpu_partition_kernel": ("pallas", "xla"),
                          "tpu_hist_kernel": ("pallas", "xla"),
                          "tpu_work_layout": ("planes", "rows"),
-                         "tpu_resident_state": ("resident", "off")}
+                         "tpu_resident_state": ("resident", "off"),
+                         "tpu_split_kernel": ("on", "off")}
                 for k, v in raw.items():
                     if k in valid and v in valid[k]:
                         pre[k] = v
@@ -1772,6 +1843,56 @@ class SerialTreeLearner:
                 Log.fatal("planes layout needs tpu_part_chunk a multiple "
                           "of 128 and, above 256, of the 256-row "
                           "compaction sub-block (got %d)", part_chunk)
+            sk = config.tpu_split_kernel
+            auto_sk = sk == "auto"
+            sk_why = ""
+            if auto_sk and "tpu_split_kernel" in pre:
+                sk = _pre("tpu_split_kernel")
+                auto_sk = False
+            elif auto_sk:
+                # auto = off: the one-kernel split's bit-parity is proven
+                # under the pallas interpreter, but the Mosaic lowering of
+                # its scan tail is unvalidated on real hardware (no TPU
+                # reachable since round 5). The first v5e session runs
+                # scripts/split_bisect.py and flips the knob — or lets the
+                # run ledger carry the measured answer forward.
+                sk = "off"
+                sk_why = ("one-kernel split parity proven under interpret "
+                          "only; Mosaic scan tail unmeasured on TPU — run "
+                          "scripts/split_bisect.py to validate, then "
+                          "enable via knob or ledger")
+            if sk == "on":
+                bad = []
+                if layout not in ("planes", "resident") \
+                        or part_kernel != "pallas":
+                    bad.append("needs the fused pallas planes/resident "
+                               "layout")
+                if mode == "int8":
+                    bad.append("int8 histograms unsupported")
+                if self.bundle is not None \
+                        or self.num_bin_hist != self.num_bin:
+                    bad.append("EFB feature bundling unsupported")
+                if self.comm.axis is not None:
+                    bad.append("multi-device comm unsupported")
+                if self.hp.use_cegb:
+                    bad.append("CEGB penalties unsupported")
+                if self.hp.has_monotone and (self.hp.mono_intermediate
+                                             or self.hp.mono_advanced):
+                    bad.append("intermediate/advanced monotone unsupported")
+                if float(config.feature_fraction_bynode) < 1.0 \
+                        or bool(config.extra_trees):
+                    bad.append("by-node sampling / extra-trees unsupported")
+                if kw.get("constraint_sets") is not None:
+                    bad.append("interaction constraint sets unsupported")
+                if hist_chunk % 128:
+                    bad.append("hist_chunk must be a multiple of 128")
+                if bad:
+                    Log.warning("tpu_split_kernel=on is not eligible here "
+                                "(%s); using the three-launch path",
+                                "; ".join(bad))
+                    sk = "off"
+                    if auto_sk:
+                        sk_why = "structurally ineligible: " + "; ".join(bad)
             # auto-knob resolution records: what auto chose and why
             # (deduped, so repeated build_kwargs calls keep one record per
             # distinct resolution)
@@ -1804,6 +1925,8 @@ class SerialTreeLearner:
             if auto_hist_chunk:
                 _rec("tpu_hist_chunk", hist_chunk,
                      "packed width %d default chunk" % self.bins.shape[1])
+            if auto_sk:
+                _rec("tpu_split_kernel", sk, sk_why)
             kw.update(
                 hist_chunk=hist_chunk,
                 part_chunk=part_chunk,
@@ -1813,6 +1936,7 @@ class SerialTreeLearner:
                 bundle=self.bundle,
                 part_kernel=part_kernel,
                 hist_kernel=hist_kernel,
+                split_kernel=sk,
                 work_layout=layout,
             )
         else:
@@ -1947,9 +2071,14 @@ class SerialTreeLearner:
             hist = w
         else:
             hist = w                    # row-major reads the packed row
+        one_kernel = kw.get("split_kernel", "off") == "on"
         return {"work_layout": layout, "work_width": int(w),
                 "partition_bytes_per_row": int(part),
-                "hist_bytes_per_row": int(hist)}
+                "hist_bytes_per_row": int(hist),
+                "split_kernel": kw.get("split_kernel", "off"),
+                # device launches per split on this config: partition +
+                # child histogram + split scan, or the fused one-kernel
+                "launches_per_split": 1 if one_kernel else 3}
 
     def train(self, ghc: jax.Array, feature_mask: jax.Array, key: jax.Array,
               cegb_used: Optional[jax.Array] = None) -> TreeLog:

@@ -236,7 +236,8 @@ def test_train_telemetry_counters_and_auto_records():
     knobs = {r["knob"]: r for r in snap["records"]["auto_resolution"]}
     assert set(knobs) == {"tpu_partition_kernel", "tpu_hist_kernel",
                           "tpu_work_layout", "tpu_resident_state",
-                          "tpu_part_chunk", "tpu_hist_chunk"}
+                          "tpu_part_chunk", "tpu_hist_chunk",
+                          "tpu_split_kernel"}
     for r in knobs.values():
         assert r["configured"] == "auto" and r["value"] and r["reason"]
     assert "traffic/work_layout" in snap["gauges"]

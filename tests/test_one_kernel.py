@@ -1,0 +1,406 @@
+"""One-kernel split (tpu_split_kernel): parity with the three-launch oracle.
+
+The fused one-kernel split (ops/partition.py one_kernel_split_planes,
+ISSUE 13) runs partition + smaller-child histogram + split scan as three
+sequential phases of ONE pallas_call. The contract is BIT-IDENTICAL trees
+to the retained three-launch chain (partition kernel, segment histogram,
+node_best_pair scan) — same routed bytes, same f32 chunk accumulation
+order, same find_best_split arithmetic. These tests pin that contract
+under the pallas interpreter on CPU (incl. NaN/missing-direction,
+categorical, multiclass and GOSS-masked gradients), pin the telemetry
+launch accounting (exactly one launch per split) and extend the
+test_retrace.py zero-recompile discipline to the fused path.
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+import lightgbm_tpu as lgb  # noqa: E402
+from lightgbm_tpu import obs  # noqa: E402
+from lightgbm_tpu.ops import partition as P  # noqa: E402
+from lightgbm_tpu.ops.histogram import hist16_segment_planes  # noqa: E402
+from lightgbm_tpu.ops.split import (FeatureMeta, SplitHyper,  # noqa: E402
+                                    find_best_split)
+
+CH = 256
+
+BASE = {"objective": "binary", "num_leaves": 8, "max_bin": 31,
+        "tree_builder": "partition", "verbosity": -1, "min_data_in_leaf": 2,
+        "tpu_work_layout": "planes", "tpu_partition_kernel": "pallas",
+        "tpu_part_chunk": CH, "tpu_hist_chunk": CH, "tpu_iter_block": 2}
+
+
+# --------------------------------------------------------------- op level
+
+def test_op_parity_interpret(rng, monkeypatch):
+    """Jitted one_kernel_split_planes vs the jitted three-launch chain on
+    the same packed planes buffer: identical routed work bytes, lt, child
+    histograms and every SplitInfo field, bit for bit."""
+    monkeypatch.setattr(P, "_INTERPRET", True)
+    n, f, num_bin = 1500, 20, 32
+    guard = CH + 2 * P.PLANE_ALIGN
+    bins = jnp.asarray(rng.randint(0, num_bin, (n, f)).astype(np.uint8))
+    ghc = rng.randn(n, 3).astype(np.float32)
+    ghc[:, 2] = 1.0
+    ghc = jnp.asarray(ghc)
+    npad = P.planes_npad(n, guard, "pallas")
+    _, w_pl = P.work_spec(f, False, "pallas", CH, CH, layout="planes")
+    work = jnp.zeros((2, w_pl, npad), jnp.uint8)
+    work, root = P.pack_planes_fold_root(work, bins, ghc, guard,
+                                         num_bins=num_bin, exact=True,
+                                         chunk=CH)
+    meta = FeatureMeta(
+        num_bins=jnp.full((f,), num_bin, jnp.int32),
+        movable_missing=jnp.zeros((f,), bool),
+        missing_bin=jnp.zeros((f,), jnp.int32),
+        is_categorical=jnp.zeros((f,), bool),
+        monotone=jnp.zeros((f,), jnp.int8),
+        penalty=jnp.ones((f,), jnp.float32),
+        cegb_coupled=jnp.zeros((f,), jnp.float32))
+    hp = SplitHyper(min_data_in_leaf=2.0)
+    fmask = jnp.ones((f,), bool)
+    root_sum = jnp.sum(ghc, axis=0)
+    info0 = find_best_split(root, root_sum, meta, fmask, hp)
+    ls = info0.left_sum[2] <= info0.right_sum[2]
+    sums2 = jnp.stack([info0.left_sum, info0.right_sum])
+    outs2 = jnp.zeros((2,), jnp.float32)
+    lows2 = jnp.full((2,), -jnp.inf, jnp.float32)
+    ups2 = jnp.full((2,), jnp.inf, jnp.float32)
+    depth = jnp.int32(1)
+    scan = jax.vmap(lambda hg, tg, po, lo, up: find_best_split(
+        hg, tg, meta, fmask, hp, parent_output=po, leaf_lower=lo,
+        leaf_upper=up, node_depth=depth))
+
+    @jax.jit
+    def oracle(work):
+        w, lt = P.partition_segment_planes_fused(
+            work, jnp.int32(0), jnp.int32(guard), jnp.int32(n),
+            info0.feature, info0.go_left, ch=CH)
+        ss = jnp.where(ls, jnp.int32(guard), jnp.int32(guard) + lt)
+        sc = jnp.where(ls, lt, jnp.int32(n) - lt)
+        hs = hist16_segment_planes(w, jnp.int32(1), ss, sc,
+                                   num_bins=num_bin, num_feat=f, chunk=CH)
+        hlg = root - hs
+        hl = jnp.where(ls, hs, hlg)
+        hr = jnp.where(ls, hlg, hs)
+        return w, lt, hl, hr, scan(jnp.stack([hl, hr]), sums2, outs2,
+                                   lows2, ups2)
+
+    @jax.jit
+    def fused(work):
+        return P.one_kernel_split_planes(
+            work, jnp.int32(0), jnp.int32(guard), jnp.int32(n),
+            info0.feature, info0.go_left, ls, depth, root, meta, fmask,
+            sums2, outs2, lows2, ups2, hp, num_bins=num_bin, num_feat=f,
+            ch=CH, hist_chunk=CH)
+
+    w_o, lt_o, hl_o, hr_o, infos_o = oracle(work)
+    w_k, lt_k, hl_k, hr_k, infos_k = fused(work)
+    assert int(lt_k) == int(lt_o)
+    assert np.array_equal(np.asarray(w_k), np.asarray(w_o))
+    assert np.array_equal(np.asarray(hl_k).view(np.uint8),
+                          np.asarray(hl_o).view(np.uint8))
+    assert np.array_equal(np.asarray(hr_k).view(np.uint8),
+                          np.asarray(hr_o).view(np.uint8))
+    for fld in infos_o._fields:
+        a, b = np.asarray(getattr(infos_o, fld)), \
+            np.asarray(getattr(infos_k, fld))
+        assert np.array_equal(a.view(np.uint8) if a.dtype.kind == "f"
+                              else a,
+                              b.view(np.uint8) if b.dtype.kind == "f"
+                              else b), fld
+
+
+def test_op_validations():
+    work = jnp.zeros((2, 40, 1280), jnp.uint8)   # 40 planes: not 32-mult
+    args = (jnp.int32(0), jnp.int32(0), jnp.int32(64), jnp.int32(0),
+            jnp.zeros((16,), bool), jnp.bool_(True), jnp.int32(1),
+            jnp.zeros((6, 16, 3), jnp.float32))
+    meta = FeatureMeta(
+        num_bins=jnp.full((6,), 16, jnp.int32),
+        movable_missing=jnp.zeros((6,), bool),
+        missing_bin=jnp.zeros((6,), jnp.int32),
+        is_categorical=jnp.zeros((6,), bool),
+        monotone=jnp.zeros((6,), jnp.int8),
+        penalty=jnp.ones((6,), jnp.float32),
+        cegb_coupled=jnp.zeros((6,), jnp.float32))
+    tail = (meta, jnp.ones((6,), bool), jnp.zeros((2, 3), jnp.float32),
+            jnp.zeros((2,), jnp.float32),
+            jnp.full((2,), -jnp.inf, jnp.float32),
+            jnp.full((2,), jnp.inf, jnp.float32), SplitHyper())
+    with pytest.raises(ValueError, match="32-sublane"):
+        P.one_kernel_split_planes(work, *args, *tail, num_bins=16,
+                                  num_feat=6, ch=256, hist_chunk=256)
+    work = jnp.zeros((2, 64, 1280), jnp.uint8)
+    with pytest.raises(ValueError, match="hist_chunk"):
+        P.one_kernel_split_planes(work, *args, *tail, num_bins=16,
+                                  num_feat=6, ch=256, hist_chunk=100)
+
+
+# ------------------------------------------------------------ tree parity
+
+def _train_tree(split_kernel, n, f, leaves, resident=False, seed=0):
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.dataset import construct_dataset
+    from lightgbm_tpu.learner import SerialTreeLearner
+
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = (X @ rng.randn(f) > 0).astype(np.float64)
+    g = rng.randn(n).astype(np.float32)
+    h = np.abs(rng.randn(n)).astype(np.float32) + 0.1
+    params = dict(BASE, num_leaves=leaves, tpu_split_kernel=split_kernel,
+                  tpu_resident_state="on" if resident else "off")
+    cfg = Config.from_params(params)
+    ds = construct_dataset(X, cfg, label=y)
+    lrn = SerialTreeLearner(cfg, ds)
+    kw = lrn.build_kwargs()
+    assert kw["split_kernel"] == split_kernel
+    assert kw["work_layout"] == ("resident" if resident else "planes")
+    ghc = jnp.stack([jnp.asarray(g), jnp.asarray(h),
+                     jnp.ones(n, jnp.float32)], axis=1)
+    return jax.device_get(
+        lrn.train(ghc, jnp.ones(ds.num_features, bool),
+                  jax.random.PRNGKey(0)))
+
+
+_FIELDS = ("split_leaf", "feature", "bin", "kind", "default_left", "gain",
+           "left_sum", "right_sum", "go_left", "leaf_value", "leaf_sum",
+           "row_leaf")
+
+
+# N deliberately NOT a multiple of the 256-row chunks; leaves=2 covers the
+# single-split tree, 15 a deep leaf-wise one; interpret mode is slow so the
+# grid stays small (the full-train suite below covers more structure)
+@pytest.mark.parametrize("n,f,leaves,resident", [
+    (1501, 20, 15, False), (1101, 16, 7, True)])
+def test_tree_parity_one_kernel(n, f, leaves, resident, monkeypatch):
+    monkeypatch.setattr(P, "_INTERPRET", True)
+    a = _train_tree("off", n, f, leaves, resident=resident)
+    b = _train_tree("on", n, f, leaves, resident=resident)
+    assert int(a.num_splits) == int(b.num_splits)
+    for fld in _FIELDS:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a, fld)), np.asarray(getattr(b, fld)),
+            err_msg=fld)
+
+
+# ----------------------------------------------------- full-train parity
+
+def _model(params, X, y, rounds=2, **dskw):
+    ds = lgb.Dataset(X, label=y, params=dict(params), **dskw)
+    bst = lgb.train(dict(params), ds, num_boost_round=rounds)
+    return bst.model_to_string()
+
+
+def _ab_models(extra, X, y, rounds=2, **dskw):
+    on = dict(BASE, tpu_split_kernel="on", **extra)
+    off = dict(BASE, tpu_split_kernel="off", **extra)
+    return (_model(on, X, y, rounds, **dskw),
+            _model(off, X, y, rounds, **dskw))
+
+
+def test_train_parity_nan_missing(rng, monkeypatch):
+    """NaN features exercise the missing-direction (default_left) scan
+    logic; model strings must match byte for byte."""
+    monkeypatch.setattr(P, "_INTERPRET", True)
+    n = 700
+    X = rng.randn(n, 6)
+    X[rng.rand(n, 6) < 0.2] = np.nan
+    y = (np.nan_to_num(X[:, 0]) + 0.2 * rng.randn(n) > 0).astype(np.float64)
+    on, off = _ab_models({"use_missing": True}, X, y)
+    assert on == off
+
+
+def test_train_parity_categorical(rng, monkeypatch):
+    monkeypatch.setattr(P, "_INTERPRET", True)
+    n = 700
+    X = rng.randn(n, 5)
+    X[:, 0] = rng.randint(0, 12, n)
+    y = ((X[:, 0] % 3 == 0) ^ (X[:, 1] > 0)).astype(np.float64)
+    on, off = _ab_models({"min_data_per_group": 5}, X, y,
+                         categorical_feature=[0])
+    assert on == off
+
+
+def test_train_parity_multiclass(rng, monkeypatch):
+    monkeypatch.setattr(P, "_INTERPRET", True)
+    n = 700
+    X = rng.randn(n, 6)
+    y = (np.abs(X[:, 0]) + X[:, 1] > 0.5).astype(np.float64) \
+        + (X[:, 2] > 0.3)
+    on, off = _ab_models({"objective": "multiclass", "num_class": 3}, X, y,
+                         rounds=1)
+    assert on == off
+
+
+def test_train_parity_goss(rng, monkeypatch):
+    """GOSS masks gradients but still streams all rows — the fused kernel
+    must reproduce the masked-gradient histograms bit for bit."""
+    monkeypatch.setattr(P, "_INTERPRET", True)
+    n = 700
+    X = rng.randn(n, 6)
+    y = (X @ rng.randn(6) > 0).astype(np.float64)
+    on, off = _ab_models({"data_sample_strategy": "goss", "top_rate": 0.3,
+                          "other_rate": 0.2}, X, y)
+    assert on == off
+
+
+# --------------------------------------------------- telemetry + retrace
+
+def test_telemetry_one_launch_per_split(rng, monkeypatch):
+    """Acceptance pin: the one-kernel path reports exactly ONE kernel
+    launch per split — partition_launches == splits, hist_launches == 0
+    (the root folds into the planes pack), scan_launches == 0."""
+    monkeypatch.setattr(P, "_INTERPRET", True)
+    n = 600
+    X = rng.randn(n, 6)
+    y = (X @ rng.randn(6) > 0).astype(np.float64)
+    params = dict(BASE, tpu_split_kernel="on")
+    ds = lgb.Dataset(X, label=y, params=dict(params))
+    obs.telemetry.reset()
+    bst = lgb.train(dict(params), ds, num_boost_round=1)
+    snap = bst.telemetry()
+    c = snap["counters"]
+    assert c["tree/splits"] > 0
+    assert c["learner/partition_launches"] == c["tree/splits"]
+    assert c.get("learner/hist_launches", 0) == 0
+    assert c.get("learner/scan_launches", 0) == 0
+    assert snap["gauges"]["learner/launches_per_split"] == 1
+    # and the oracle path still reports 3 per split
+    params = dict(BASE, tpu_split_kernel="off")
+    ds = lgb.Dataset(X, label=y, params=dict(params))
+    obs.telemetry.reset()
+    bst = lgb.train(dict(params), ds, num_boost_round=1)
+    snap = bst.telemetry()
+    c = snap["counters"]
+    assert c["learner/hist_launches"] == c["tree/splits"]
+    assert c["learner/scan_launches"] == c["tree/splits"]
+    assert snap["gauges"]["learner/launches_per_split"] == 3
+
+
+def test_second_identical_train_compiles_nothing(rng, monkeypatch):
+    """test_retrace.py discipline on the one-kernel path: a second train at
+    identical shapes/config hits every jit cache — zero new compiles."""
+    monkeypatch.setattr(P, "_INTERPRET", True)
+    n = 520                      # shape distinct from other test modules
+    X = rng.randn(n, 7)
+    y = (X @ rng.randn(7) > 0).astype(np.float64)
+    params = dict(BASE, tpu_split_kernel="on")
+    ds = lgb.Dataset(X, label=y, params=dict(params))
+    lgb.train(dict(params), ds, num_boost_round=2)   # warm every cache
+    obs.telemetry.reset()
+    bst = lgb.train(dict(params), ds, num_boost_round=2)
+    jc = bst.telemetry()["jit_compiles"]
+    assert jc["total"] == 0, jc
+    assert jc["backend_compiles"] == 0, jc
+
+
+# ------------------------------------------------------------ knob gates
+
+def test_config_rejects_bad_split_kernel():
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.utils.log import LightGBMError
+
+    with pytest.raises(LightGBMError, match="tpu_split_kernel"):
+        Config.from_params({"tpu_split_kernel": "maybe"})
+
+
+def test_auto_resolves_off_with_record(rng):
+    """auto stays off everywhere until the kernel is validated on real
+    Mosaic; the resolution is recorded like the other six auto knobs."""
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.dataset import construct_dataset
+    from lightgbm_tpu.learner import SerialTreeLearner
+
+    X = rng.randn(300, 4)
+    y = (X[:, 0] > 0).astype(np.float64)
+    cfg = Config.from_params({"objective": "binary", "num_leaves": 4,
+                              "max_bin": 15, "tree_builder": "partition",
+                              "verbosity": -1})
+    ds = construct_dataset(X, cfg, label=y)
+    obs.telemetry.reset()
+    kw = SerialTreeLearner(cfg, ds).build_kwargs()
+    assert kw["split_kernel"] == "off"
+    recs = obs.telemetry.snapshot()["records"]["auto_resolution"]
+    mine = [r for r in recs if r["knob"] == "tpu_split_kernel"]
+    assert len(mine) == 1
+    assert mine[0]["value"] == "off"
+    assert "split_bisect" in mine[0]["reason"]
+
+
+def test_ineligible_on_downgrades_to_off(rng):
+    """Forcing on where the structure can't support it warns and falls
+    back to the three-launch path instead of failing the train."""
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.dataset import construct_dataset
+    from lightgbm_tpu.learner import SerialTreeLearner
+
+    X = rng.randn(300, 4)
+    y = (X[:, 0] > 0).astype(np.float64)
+    # rows layout: no planes partition stream to fuse into
+    cfg = Config.from_params({"objective": "binary", "num_leaves": 4,
+                              "max_bin": 15, "tree_builder": "partition",
+                              "verbosity": -1, "tpu_work_layout": "rows",
+                              "tpu_split_kernel": "on"})
+    ds = construct_dataset(X, cfg, label=y)
+    kw = SerialTreeLearner(cfg, ds).build_kwargs()
+    assert kw["split_kernel"] == "off"
+    # CEGB is a scan-input the kernel does not carry
+    cfg = Config.from_params(dict(BASE, num_leaves=4, max_bin=15,
+                                  tpu_split_kernel="on",
+                                  cegb_penalty_split=0.1))
+    ds = construct_dataset(X, cfg, label=y)
+    kw = SerialTreeLearner(cfg, ds).build_kwargs()
+    assert kw["split_kernel"] == "off"
+
+
+def test_builder_rejects_ineligible_on():
+    """build_tree_partitioned itself re-validates (defense in depth for
+    direct callers bypassing the learner gate)."""
+    from lightgbm_tpu.learner import Comm, build_tree_partitioned
+    from lightgbm_tpu.ops.split import SplitHyper
+
+    f = 4
+    meta = FeatureMeta(
+        num_bins=jnp.full((f,), 8, jnp.int32),
+        movable_missing=jnp.zeros((f,), bool),
+        missing_bin=jnp.zeros((f,), jnp.int32),
+        is_categorical=jnp.zeros((f,), bool),
+        monotone=jnp.zeros((f,), jnp.int8),
+        penalty=jnp.ones((f,), jnp.float32),
+        cegb_coupled=jnp.zeros((f,), jnp.float32))
+    with pytest.raises(ValueError, match="not eligible"):
+        build_tree_partitioned(
+            jnp.zeros((64, f), jnp.uint8), jnp.zeros((64, 3), jnp.float32),
+            meta, jnp.ones((f,), bool), jax.random.PRNGKey(0),
+            jnp.zeros((f,), bool), SplitHyper(), num_leaves=4, num_bin=8,
+            comm=Comm(), split_kernel="on", work_layout="rows")
+
+
+def test_traffic_spec_launches(rng):
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.dataset import construct_dataset
+    from lightgbm_tpu.learner import SerialTreeLearner
+
+    X = rng.randn(300, 4)
+    y = (X[:, 0] > 0).astype(np.float64)
+
+    def spec(sk):
+        cfg = Config.from_params(dict(BASE, num_leaves=4, max_bin=15,
+                                      tpu_split_kernel=sk))
+        ds = construct_dataset(X, cfg, label=y)
+        return SerialTreeLearner(cfg, ds).traffic_spec()
+
+    assert spec("off")["launches_per_split"] == 3
+    on = spec("on")
+    assert on["split_kernel"] == "on"
+    assert on["launches_per_split"] == 1
