@@ -342,7 +342,8 @@ class Booster:
     def save_model(self, filename: str, num_iteration: Optional[int] = None,
                    start_iteration: int = 0) -> "Booster":
         ni = -1 if num_iteration is None else num_iteration
-        self.inner.best_iteration = self.best_iteration
+        with self.inner._cache_lock:
+            self.inner.best_iteration = self.best_iteration
         self.inner.save_model(filename, ni)
         return self
 
@@ -458,11 +459,17 @@ class Booster:
         (online promotion: single version bump under the model lock, so
         concurrent PredictSessions see old-or-new, never a mix). Returns
         a rollback token for :meth:`restore`."""
-        return self.inner.adopt(getattr(other, "inner", other))
+        token = self.inner.adopt(getattr(other, "inner", other))
+        # keep the wrapper's predict-default cap in step with the swap
+        with self.inner._cache_lock:
+            self.best_iteration = self.inner.best_iteration
+        return token
 
     def restore(self, snapshot: tuple) -> "Booster":
         """Roll back to a model captured by :meth:`adopt`."""
         self.inner.restore(snapshot)
+        with self.inner._cache_lock:
+            self.best_iteration = self.inner.best_iteration
         return self
 
 

@@ -663,22 +663,29 @@ class GBDT:
         Returns an opaque rollback token for :meth:`restore`.
         """
         with self._cache_lock:
-            snap = (list(self.models), self.init_scores.copy(), self.iter_)
+            snap = (list(self.models), self.init_scores.copy(), self.iter_,
+                    self.best_iteration)
             self.models = list(other.models)
             self.init_scores = np.asarray(other.init_scores,
                                           np.float64).copy()
             self.iter_ = int(other.iter_)
+            # the adopted model's stored early-stop cap replaces ours:
+            # a booster loaded from a 6-tree publish would otherwise keep
+            # best_iteration=6 forever and silently truncate every later
+            # adopted model with more trees at predict time
+            self.best_iteration = int(getattr(other, "best_iteration", -1))
             self._bump_model_version()
         return snap
 
     def restore(self, snapshot: tuple) -> None:
         """Roll back to a model captured by :meth:`adopt` (same single
         version-bump atomicity as the promotion itself)."""
-        models, init_scores, it = snapshot
+        models, init_scores, it, best_it = snapshot
         with self._cache_lock:
             self.models = list(models)
             self.init_scores = np.asarray(init_scores, np.float64).copy()
             self.iter_ = int(it)
+            self.best_iteration = int(best_it)
             self._bump_model_version()
 
     def _packed_model(self, start: int, end: int):

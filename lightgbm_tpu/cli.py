@@ -9,6 +9,7 @@ LoadParameters). Usage mirrors the reference:
 """
 from __future__ import annotations
 
+import os
 import sys
 from typing import Any, Dict, List, Optional
 
@@ -197,7 +198,15 @@ class Application:
         boot, and publishes every promotion/rollback as a version-tokened
         artifact; ``fleet_role=replica`` serves without training, watching
         the store and hot-swapping each published version through the
-        adopt path — see lightgbm_tpu/fleet/."""
+        adopt path — see lightgbm_tpu/fleet/.
+
+        Fleet hardening: ``fleet_lease_ttl_s>0`` makes the trainer
+        lease-gated (boots in standby, trains only while holding the
+        store lease, epoch-fenced publishes — run two trainer processes
+        on one store and the survivor takes over);
+        ``fleet_compact_bytes``/``fleet_keep_artifacts`` bound the store;
+        ``fleet_url=http://trainer:port`` points a replica at a remote
+        trainer's /fleet endpoints instead of a shared filesystem."""
         cfg = self.config
         entries = []
         if cfg.input_model:
@@ -205,10 +214,11 @@ class Application:
         for spec in cfg.serve_models:
             mid, path = spec.split("=", 1)
             entries.append((mid.strip(), path.strip()))
-        if not entries and not cfg.fleet_dir:
+        if not entries and not cfg.fleet_dir and not cfg.fleet_url:
             Log.fatal("task=serve requires input_model or serve_models")
-        fleet_trainer = bool(cfg.fleet_dir) and cfg.fleet_role == "trainer"
-        fleet_replica = bool(cfg.fleet_dir) and cfg.fleet_role == "replica"
+        fleet_on = bool(cfg.fleet_dir) or bool(cfg.fleet_url)
+        fleet_trainer = fleet_on and cfg.fleet_role == "trainer"
+        fleet_replica = fleet_on and cfg.fleet_role == "replica"
         if fleet_trainer and not cfg.online_train:
             Log.fatal("fleet_role=trainer requires online_train=true (the "
                       "trainer is the process that publishes promotions)")
@@ -216,7 +226,7 @@ class Application:
             Log.fatal("fleet_role=replica is serve-only (replicas apply "
                       "published models, they never train); drop "
                       "online_train or use fleet_role=trainer")
-        if cfg.fleet_dir and len(entries) > 1:
+        if fleet_on and len(entries) > 1:
             Log.fatal("fleet mode serves one model per store; drop "
                       "serve_models or run one process per model")
         if fleet_replica and not entries:
@@ -252,14 +262,27 @@ class Application:
                 from .fleet import FleetStore, bootstrap_model
                 store = FleetStore(cfg.fleet_dir, mid)
                 booster, applied = bootstrap_model(store)
-                if booster is not None:
-                    Log.info("fleet: %s booted from published v%d",
-                             mid, applied)
+            elif cfg.fleet_url:
+                from .fleet import RemoteStore, bootstrap_model
+                store = RemoteStore(cfg.fleet_url,
+                                    timeout_s=cfg.fleet_timeout_s,
+                                    backoff_max_s=cfg.fleet_backoff_max_s)
+                try:
+                    booster, applied = bootstrap_model(store)
+                except Exception as exc:
+                    # the remote trainer may simply not be up yet; the
+                    # watcher keeps retrying with backoff
+                    Log.warning("fleet: remote bootstrap failed (%s: "
+                                "%s); watching %s for the first publish",
+                                type(exc).__name__, exc, cfg.fleet_url)
+            if booster is not None:
+                Log.info("fleet: %s booted from published v%d",
+                         mid, applied)
             if booster is None:
                 if not path:
                     Log.fatal("fleet: store %s has no published model yet "
                               "and no input_model to seed from",
-                              cfg.fleet_dir)
+                              cfg.fleet_dir or cfg.fleet_url)
                 booster = Booster(model_file=path)
                 if fleet_trainer and store.latest_publish() is None:
                     # seed the store so replicas can boot before the
@@ -269,8 +292,14 @@ class Application:
             if online_cfg is not None:
                 model_online = dict(online_cfg)
                 if fleet_trainer:
-                    model_online.update(store=store,
-                                        replay=cfg.fleet_replay)
+                    import socket
+                    model_online.update(
+                        store=store, replay=cfg.fleet_replay,
+                        lease_ttl_s=cfg.fleet_lease_ttl_s,
+                        holder_id="%s:%d" % (socket.gethostname(),
+                                             os.getpid()),
+                        compact_bytes=cfg.fleet_compact_bytes,
+                        keep_artifacts=cfg.fleet_keep_artifacts)
             entry = registry.register(
                 mid, booster,
                 buckets=cfg.serve_buckets or None,
@@ -288,16 +317,25 @@ class Application:
                 watcher = ReplicaWatcher(
                     entry.booster, store,
                     poll_interval_s=cfg.fleet_poll_interval_s,
-                    applied_version=applied)
+                    applied_version=applied,
+                    backoff_max_s=cfg.fleet_backoff_max_s)
         server = PredictServer(registry=registry, host=cfg.serve_host,
                                port=cfg.serve_port)
         server.fleet_watcher = watcher
+        if cfg.fleet_dir and store is not None:
+            # local store: serve the /fleet transport routes (remote
+            # replicas converge through them) + /healthz lease/log state
+            server.fleet_store = store
+        elif cfg.fleet_url and store is not None:
+            # remote store: surface transport retry/backoff on /healthz
+            server.fleet_transport = store
         host, port = server.address
         Log.info("Serving %s on http://%s:%d (POST /predict, /ingest; GET "
                  "/healthz, /models, /telemetry, /metrics)%s",
                  ", ".join("%s=%s" % e for e in entries), host, port,
-                 " [fleet %s @ %s]" % (cfg.fleet_role, cfg.fleet_dir)
-                 if cfg.fleet_dir else "")
+                 " [fleet %s @ %s]" % (cfg.fleet_role,
+                                       cfg.fleet_dir or cfg.fleet_url)
+                 if (cfg.fleet_dir or cfg.fleet_url) else "")
         stop_dump = None
         if cfg.dump_telemetry and cfg.telemetry_dump_interval_s > 0:
             # a wedged server still leaves fresh counters on disk

@@ -267,6 +267,19 @@ class Config:
     fleet_replay: bool = True         # replay the event log on trainer boot
     #   (rows past the consumed watermark re-enter the training buffer,
     #   older rows only the shadow window)
+    fleet_lease_ttl_s: float = 0.0    # trainer failover lease ttl (0 = one
+    #   immortal trainer). >0: boot in standby, train only while holding
+    #   the store lease; heartbeat every ttl/3; epoch-fenced publishes
+    fleet_compact_bytes: int = 0      # compact events.jsonl once it exceeds
+    #   this size (0 = never): snapshot watermark/streak + truncate the
+    #   replayed prefix, replay stays bit-identical
+    fleet_keep_artifacts: int = 0     # retention at compaction: keep only
+    #   this many newest publish artifacts (0 = keep all)
+    fleet_url: str = ""               # replica only: poll a remote trainer's
+    #   /fleet endpoints instead of a shared-filesystem fleet_dir
+    fleet_timeout_s: float = 5.0      # remote transport per-request timeout
+    fleet_backoff_max_s: float = 10.0  # cap for replica poll backoff and
+    #   remote transport retry backoff
 
     # ---- objective (reference: config.h "Objective Parameters") ----
     num_class: int = 1
@@ -490,9 +503,29 @@ class Config:
         if self.fleet_poll_interval_s <= 0:
             Log.fatal("fleet_poll_interval_s must be > 0, got %g",
                       self.fleet_poll_interval_s)
-        if self.fleet_dir == "" and self.fleet_role == "replica":
-            Log.fatal("fleet_role=replica requires a fleet_dir (the store "
-                      "the replica watches)")
+        if self.fleet_dir == "" and self.fleet_url == "" \
+                and self.fleet_role == "replica":
+            Log.fatal("fleet_role=replica requires a fleet_dir (shared "
+                      "filesystem) or fleet_url (remote trainer) to watch")
+        if self.fleet_dir != "" and self.fleet_url != "":
+            Log.fatal("fleet_dir and fleet_url are mutually exclusive "
+                      "(one store per replica)")
+        if self.fleet_url != "" and self.fleet_role != "replica":
+            Log.fatal("fleet_url is replica-only (the trainer owns the "
+                      "local store it serves)")
+        if self.fleet_lease_ttl_s < 0:
+            Log.fatal("fleet_lease_ttl_s must be >= 0, got %g",
+                      self.fleet_lease_ttl_s)
+        if self.fleet_compact_bytes < 0 or self.fleet_keep_artifacts < 0:
+            Log.fatal("fleet_compact_bytes/fleet_keep_artifacts must be "
+                      ">= 0")
+        if self.fleet_timeout_s <= 0:
+            Log.fatal("fleet_timeout_s must be > 0, got %g",
+                      self.fleet_timeout_s)
+        if self.fleet_backoff_max_s < self.fleet_poll_interval_s:
+            Log.fatal("fleet_backoff_max_s must be >= "
+                      "fleet_poll_interval_s, got %g < %g",
+                      self.fleet_backoff_max_s, self.fleet_poll_interval_s)
         if self.linear_device not in ("auto", "off", "on"):
             Log.fatal("linear_device must be auto, off or on; got %s",
                       self.linear_device)

@@ -123,7 +123,10 @@ def train(
             Log.warning("Stopped training because there are no more leaves "
                         "that meet the split requirements")
         booster.best_iteration = booster.inner.iter_
-        booster.inner.best_iteration = booster.best_iteration
+        # adopt()/restore() update this field from watcher threads under
+        # the model lock; take it here too so the field has one guard
+        with booster.inner._cache_lock:
+            booster.inner.best_iteration = booster.best_iteration
         _ledger_record(booster)
         return booster
 
@@ -168,7 +171,8 @@ def train(
             break
     if booster.best_iteration < 0:
         booster.best_iteration = booster.inner.iter_
-    booster.inner.best_iteration = booster.best_iteration
+    with booster.inner._cache_lock:
+        booster.inner.best_iteration = booster.best_iteration
     global_timer.maybe_report()
     _ledger_record(booster)
     return booster

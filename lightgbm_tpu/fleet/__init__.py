@@ -15,6 +15,19 @@ adds the fleet layer on top of it:
   the existing ``GBDT.adopt`` path, so every replica serves whole
   historical models only (one version bump per applied publish).
 
+Fleet hardening (PR 13) removes the layer's three single points of
+failure: :class:`~lightgbm_tpu.fleet.store.FleetStore` grew a trainer
+lease with epoch fencing (a standby trainer takes over a dead holder's
+lease and a fenced-off zombie cannot publish), log compaction with
+bit-identical replay, sha256-verified artifacts with
+fall-back-to-previous-good, and orphan reaping;
+:class:`~lightgbm_tpu.fleet.transport.RemoteStore` serves replicas that
+do NOT share the trainer's filesystem (publish feed + artifacts over
+stdlib HTTP with retries, capped deterministic-jitter backoff and
+checksum verification); and :mod:`lightgbm_tpu.fleet.chaos` is the
+seeded fault-injection switchboard the failover tests drive all of it
+with.
+
 Per-tenant fairness (admission quotas + weighted-fair dequeue) lives in
 :mod:`lightgbm_tpu.serve.batcher`; promotion hysteresis and the
 auto-rollback live-metric watch live in
@@ -22,6 +35,9 @@ auto-rollback live-metric watch live in
 durability and distribution substrate they plug into.
 """
 from .replica import ReplicaWatcher, bootstrap_model
-from .store import FleetStore
+from .store import (CorruptArtifactError, FleetStore, StaleLeaseError)
+from .transport import RemoteStore, TransportError
 
-__all__ = ["FleetStore", "ReplicaWatcher", "bootstrap_model"]
+__all__ = ["FleetStore", "ReplicaWatcher", "RemoteStore",
+           "bootstrap_model", "StaleLeaseError", "CorruptArtifactError",
+           "TransportError"]

@@ -1,0 +1,181 @@
+"""Deterministic fault injection for the fleet layer.
+
+The durability claims in this package (torn appends are skipped on
+replay, a zombie trainer's publishes are fenced, a replica survives
+dropped connections and torn artifact reads) are only claims until a
+test can *make* those faults happen on demand. This module is the
+switchboard: production code calls :func:`hit` at named failure points,
+and a test installs a :class:`FaultPlan` — an explicit, seeded,
+per-point FIFO of actions — so every fault fires at a deterministic
+call count, never off a wall-clock race.
+
+Failure points (the strings passed to :func:`hit`):
+
+- ``store/append``        before an event-log line is written
+- ``store/publish``       after artifact replace, before the event lands
+- ``store/artifact_read`` before a model artifact is read back
+- ``store/lease``         before a lease record is replaced
+- ``transport/request``   client side, before an HTTP request is issued
+- ``transport/serve``     server side, before a /fleet response is sent
+
+Actions are tuples: ``("raise", exc)`` raises inside :func:`hit`;
+``("sleep", seconds)`` stalls inside :func:`hit` (slow store / slow
+response); ``("torn", fraction)`` is RETURNED to the caller, which is
+responsible for truncating its write/read/response body to that
+fraction — tearing is inherently caller-specific. With no plan
+installed ``hit`` is one global load and a None check, so the hooks
+cost nothing in production.
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..obs import telemetry
+
+#: every failure point production code calls hit() with, for validation
+FAILURE_POINTS = (
+    "store/append",
+    "store/publish",
+    "store/artifact_read",
+    "store/lease",
+    "transport/request",
+    "transport/serve",
+)
+
+
+class InjectedFault(Exception):
+    """Default exception for ("raise", ...) actions — distinguishable
+    from real faults in test assertions and log lines."""
+
+
+Action = Tuple[Any, ...]
+
+
+class FaultPlan:
+    """A per-point FIFO of fault actions, consumed by :func:`hit`.
+
+    Build one explicitly (``FaultPlan({"store/append": [("torn", 0.5)]})``)
+    when a test needs one exact fault at one exact call, or with
+    :meth:`seeded` when a scenario wants *many* faults whose mix is
+    reproducible from a single integer. Consumption is thread-safe; the
+    schedule itself is fixed at construction so two runs with the same
+    plan inject identically regardless of thread timing per point.
+    """
+
+    def __init__(self, actions: Optional[Dict[str, Sequence[Action]]] = None
+                 ) -> None:
+        self._lock = threading.Lock()
+        self._queues: Dict[str, List[Action]] = {}
+        self._injected: Dict[str, int] = {}
+        for point, acts in (actions or {}).items():
+            self.add(point, *acts)
+
+    @classmethod
+    def seeded(cls, seed: int, counts: Dict[str, int], *,
+               sleep_s: float = 0.05) -> "FaultPlan":
+        """A plan with ``counts[point]`` faults per point, the action mix
+        drawn deterministically from ``random.Random(seed)``. Same seed +
+        counts → byte-identical schedule, independent of wall clock."""
+        rng = random.Random(int(seed))
+        plan = cls()
+        for point in sorted(counts):
+            for _ in range(int(counts[point])):
+                roll = rng.random()
+                if roll < 0.4:
+                    act: Action = ("raise",
+                                   InjectedFault("chaos@%s" % point))
+                elif roll < 0.7:
+                    act = ("torn", 0.1 + 0.8 * rng.random())
+                else:
+                    act = ("sleep", sleep_s * rng.random())
+                plan.add(point, act)
+        return plan
+
+    def add(self, point: str, *actions: Action) -> "FaultPlan":
+        if point not in FAILURE_POINTS:
+            raise ValueError("unknown chaos point %r (known: %s)"
+                             % (point, ", ".join(FAILURE_POINTS)))
+        with self._lock:
+            self._queues.setdefault(point, []).extend(actions)
+        return self
+
+    def next_action(self, point: str) -> Optional[Action]:
+        with self._lock:
+            queue = self._queues.get(point)
+            if not queue:
+                return None
+            self._injected[point] = self._injected.get(point, 0) + 1
+            return queue.pop(0)
+
+    def pending(self) -> Dict[str, int]:
+        with self._lock:
+            return {p: len(q) for p, q in self._queues.items() if q}
+
+    def injected(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._injected)
+
+
+#: the installed plan; None (the fast path) outside chaos tests
+_active: Optional[FaultPlan] = None  # graftlint: disable=module-mutable-state -- test-only injection switchboard, installed/uninstalled under _active_lock
+_active_lock = threading.Lock()  # graftlint: disable=module-mutable-state -- guards _active install/uninstall
+
+
+def install(plan: FaultPlan) -> None:
+    global _active
+    with _active_lock:
+        _active = plan
+
+
+def uninstall() -> None:
+    global _active
+    with _active_lock:
+        _active = None
+
+
+class inject:
+    """``with chaos.inject(plan): ...`` — install for the block, always
+    uninstall after, so a failing test can't leak faults into the next."""
+
+    def __init__(self, plan: FaultPlan) -> None:
+        self._plan = plan
+
+    def __enter__(self) -> FaultPlan:
+        install(self._plan)
+        return self._plan
+
+    def __exit__(self, *exc) -> None:
+        uninstall()
+
+
+def active() -> Optional[FaultPlan]:
+    return _active
+
+
+def hit(point: str) -> Optional[Action]:
+    """Consume one fault at ``point`` if a plan is installed.
+
+    Raises for ("raise", exc) actions, stalls for ("sleep", s) actions,
+    and returns ("torn", fraction) for the caller to apply. Returns None
+    (and does nothing) when no plan is installed or the point's queue is
+    empty."""
+    plan = _active
+    if plan is None:
+        return None
+    act = plan.next_action(point)
+    if act is None:
+        return None
+    telemetry.count("chaos/injected/" + point)
+    kind = act[0]
+    if kind == "raise":
+        exc = act[1]
+        if isinstance(exc, BaseException):
+            raise exc
+        raise exc("chaos@%s" % point)
+    if kind == "sleep":
+        time.sleep(float(act[1]))
+        return None
+    return act

@@ -10,6 +10,17 @@ old ensemble or the new one whole. This is the single-trainer /
 many-workers decomposition of arXiv:1611.01276 applied to serving:
 replicas never train, they only apply whole historical models.
 
+The store is duck-typed: a filesystem
+:class:`~lightgbm_tpu.fleet.store.FleetStore` or a
+:class:`~lightgbm_tpu.fleet.transport.RemoteStore` polling a trainer's
+``/fleet`` endpoints over HTTP — the watcher code is identical. Loads
+go through ``latest_valid_publish``, which verifies each artifact
+against the sha256 + length in its publish event and walks back to the
+previous good publish past corruption; stale-epoch publishes from a
+fenced-off zombie trainer are rejected inside the store scan. A failing
+store backs the poll off exponentially (capped, reset on first success)
+so a dead store is not hammered at ``poll_interval_s``.
+
 Rollbacks distribute the same way: the trainer publishes the restored
 model under a NEW version token, and replicas converge by always
 applying the newest token (exactly one local version bump per applied
@@ -24,19 +35,18 @@ from typing import Any, Dict, Optional
 from ..obs import telemetry
 from ..obs_trace import tracer
 from ..utils.log import LightGBMError, Log
-from .store import FleetStore
 
 
-def bootstrap_model(store: FleetStore):
-    """(booster, version) from the store's newest publish, or (None, 0)
-    when nothing was published yet (the replica then needs an
-    ``input_model`` to boot from)."""
-    latest = store.latest_publish()
-    if latest is None:
+def bootstrap_model(store):
+    """(booster, version) from the store's newest verified publish, or
+    (None, 0) when nothing usable was published yet (the replica then
+    needs an ``input_model`` to boot from)."""
+    loaded = store.latest_valid_publish(0)
+    if loaded is None:
         return None, 0
+    event, model_str = loaded
     from ..basic import Booster
-    return Booster(model_str=store.load_model(latest["version"])), \
-        int(latest["version"])
+    return Booster(model_str=model_str), int(event["version"])
 
 
 class _ArtifactLoader:
@@ -47,12 +57,18 @@ class _ArtifactLoader:
     pattern), and the only shared-model call left on the poller thread
     is the lock-guarded ``adopt``."""
 
-    def __init__(self, store: FleetStore) -> None:
+    def __init__(self, store) -> None:
         self._store = store
 
-    def load(self, version: int):
+    def fetch(self, min_version: int):
+        """(event, candidate booster) for the newest verified publish
+        past ``min_version``, or None."""
+        loaded = self._store.latest_valid_publish(min_version)
+        if loaded is None:
+            return None
+        event, model_str = loaded
         from ..basic import Booster
-        return Booster(model_str=self._store.load_model(version))
+        return event, Booster(model_str=model_str)
 
 
 class ReplicaWatcher:
@@ -62,27 +78,36 @@ class ReplicaWatcher:
     ``start=True`` (default) runs a named daemon thread polling every
     ``poll_interval_s``; tests drive :meth:`poll_once` synchronously with
     ``start=False``. Each applied publish is one ``Booster.adopt`` — one
-    version bump, whole model, never a partial state.
+    version bump, whole model, never a partial state. Poll failures back
+    off exponentially up to ``backoff_max_s`` (gauge
+    ``fleet/poll_backoff_ms``), reset by the next success.
     """
 
-    def __init__(self, booster, store: FleetStore, *,
+    def __init__(self, booster, store, *,
                  poll_interval_s: float = 0.5,
                  applied_version: int = 0,
+                 backoff_max_s: float = 10.0,
                  start: bool = True) -> None:
         if poll_interval_s <= 0:
             raise LightGBMError("fleet poll_interval_s must be > 0, "
                                 "got %g" % poll_interval_s)
+        if backoff_max_s < poll_interval_s:
+            raise LightGBMError("fleet backoff_max_s must be >= "
+                                "poll_interval_s, got %g < %g"
+                                % (backoff_max_s, poll_interval_s))
         self._booster = booster
         self._store = store
         self._poll = float(poll_interval_s)
-        # guards the applied-version token and the swap counters (the
-        # poller thread writes them, /healthz handler threads read), and
-        # doubles as the poller's wakeup so close() never waits a full
-        # poll interval
+        self._backoff_max = float(backoff_max_s)
+        # guards the applied-version token, the swap counters and the
+        # error-backoff state (the poller thread writes them, /healthz
+        # handler threads read), and doubles as the poller's wakeup so
+        # close() never waits a full poll interval
         self._lock = threading.Condition()
         self._applied = int(applied_version)
         self._swaps = 0
         self._errors = 0
+        self._backoff = 0.0
         self._last_error = ""
         self._last_swap_ts = 0.0
         self._stopped = False
@@ -101,15 +126,18 @@ class ReplicaWatcher:
         latest = self._store.latest_publish()
         if latest is None:
             return False
-        version = int(latest["version"])
         with self._lock:
-            if version <= self._applied:
-                return False
-        # the artifact is a complete historical model (os.replace'd
-        # before its event): build the private candidate off-lock, then
-        # adopt — ONE version bump, whole-model invariant held
-        loader = _ArtifactLoader(self._store)
-        candidate = loader.load(version)
+            applied = self._applied
+        if int(latest["version"]) <= applied:
+            return False
+        # checksum-verified fetch, falling back past corrupt artifacts;
+        # build the private candidate off-lock, then adopt — ONE version
+        # bump, whole-model invariant held
+        loaded = _ArtifactLoader(self._store).fetch(applied)
+        if loaded is None:
+            return False
+        event, candidate = loaded
+        version = int(event["version"])
         with tracer.span("fleet/replica_swap", domain="serve",
                          version=version):
             self._booster.adopt(candidate)
@@ -120,7 +148,7 @@ class ReplicaWatcher:
         telemetry.count("fleet/replica_swaps")
         telemetry.gauge("fleet/applied_version", version)
         Log.info("fleet: replica adopted published model v%d (%s)",
-                 version, latest.get("event"))
+                 version, event.get("event"))
         return True
 
     def _worker(self) -> None:
@@ -128,20 +156,33 @@ class ReplicaWatcher:
             with self._lock:
                 if self._stopped:
                     return
-                self._lock.wait(timeout=self._poll)
+                wait = self._backoff if self._backoff > 0 else self._poll
+                self._lock.wait(timeout=wait)
                 if self._stopped:
                     return
             try:
                 self.poll_once()
+                with self._lock:
+                    had_backoff = self._backoff > 0
+                    self._backoff = 0.0
+                if had_backoff:
+                    telemetry.gauge("fleet/poll_backoff_ms", 0.0)
             except Exception as exc:
-                # a torn read or transient FS error must not kill the
-                # watcher: count it and retry next poll
+                # a torn read or transient FS/network error must not kill
+                # the watcher: count it, back off, retry
                 with self._lock:
                     self._errors += 1
                     self._last_error = "%s: %s" % (type(exc).__name__, exc)
+                    self._backoff = min(
+                        self._backoff_max,
+                        (self._backoff if self._backoff > 0
+                         else self._poll) * 2.0)
+                    backoff = self._backoff
                 telemetry.count("fleet/replica_poll_errors")
-                Log.warning("fleet: replica poll failed: %s: %s",
-                            type(exc).__name__, exc)
+                telemetry.gauge("fleet/poll_backoff_ms",
+                                backoff * 1000.0)
+                Log.warning("fleet: replica poll failed (backoff %gs): "
+                            "%s: %s", backoff, type(exc).__name__, exc)
 
     # ------------------------------------------------------------------- state
     @property
@@ -158,6 +199,7 @@ class ReplicaWatcher:
                 "applied_version": self._applied,
                 "swaps": self._swaps,
                 "poll_errors": self._errors,
+                "poll_backoff_s": self._backoff,
                 "last_error": self._last_error,
                 "last_swap_ts": self._last_swap_ts,
                 "poll_interval_s": self._poll,

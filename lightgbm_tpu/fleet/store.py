@@ -2,13 +2,14 @@
 
     <root>/<model_id>/events.jsonl      append-only event log
     <root>/<model_id>/models/v%06d.txt  immutable whole-model artifacts
+    <root>/<model_id>/lease.json        trainer lease (holder + epoch)
 
 The event log rides the PR-10 ledger substrate
 (:func:`~lightgbm_tpu.obs_ledger.append_jsonl` /
 :func:`~lightgbm_tpu.obs_ledger.read_jsonl`): every append is ONE write
 call of one JSON line, so concurrent writers (HTTP ingest handlers, the
 trainer worker) interleave whole lines and a SIGKILL mid-append leaves at
-most one partial line, skipped on read. Three event kinds:
+most one partial line, skipped on read. Event kinds:
 
 - ``ingest``: one labeled traffic chunk (rows + labels). Replayed on
   boot so a restarted server resumes its shadow window and training
@@ -20,24 +21,43 @@ most one partial line, skipped on read. Three event kinds:
   increasing **version token**. The artifact is written to a temp file
   and ``os.replace``d into place BEFORE the event lands, so a replica
   that sees the event always reads a complete model — whole historical
-  models only, never a torn artifact.
+  models only, never a torn artifact. The event records the artifact's
+  ``sha256`` + byte length (verified on load) and the publisher's
+  ``lease_epoch`` (zombie fencing, below).
+- ``compact``: a snapshot record (watermark, win streak, row base,
+  version/epoch floors) standing in for every event truncated before it
+  — replay from a compacted log is bit-identical to the full log.
 
 Rollbacks are publishes too (``event="rollback"``): replicas converge by
 always applying the newest version token, so a rollback distributes
 exactly like a promotion.
+
+**Failover.** Exactly one trainer may publish at a time. The lease file
+holds ``{holder, epoch, expires_ts}`` and is swapped atomically
+(``os.replace``); every acquisition — takeover OR re-acquisition —
+bumps ``epoch``, the fencing token. A trainer arms its store with
+:meth:`set_fence`; :meth:`publish` then re-reads the lease and refuses
+(:class:`StaleLeaseError`) unless holder+epoch still match, so a paused
+("zombie") trainer that lost its lease cannot publish over its
+successor. Readers additionally reject any publish event whose epoch is
+below an epoch already seen earlier in the log (a zombie write that
+raced the fence check on another host).
 """
 from __future__ import annotations
 
+import hashlib
+import json
 import os
 import threading
 import time
-from typing import Any, Dict, Iterator, List, Optional
+from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
 from ..obs import telemetry
 from ..obs_ledger import append_jsonl, read_jsonl
-from ..utils.log import LightGBMError
+from ..utils.log import LightGBMError, Log
+from . import chaos
 
 #: schema version stamped on every event; readers skip newer majors
 STORE_VERSION = 1
@@ -47,6 +67,39 @@ PUBLISH_EVENTS = ("boot", "promotion", "rollback")
 
 _ARTIFACT_FMT = "v%06d.txt"
 
+#: a lease-acquisition guard file older than this is a crashed acquirer
+_GUARD_STALE_S = 5.0
+
+
+def _verify_artifact(event: Dict[str, Any], data: bytes) -> None:
+    """Check artifact ``data`` against its publish event's sha256 + byte
+    length (shared with the HTTP transport's downloaded copies). Events
+    from before checksums carry no ``sha256`` and pass. Raises
+    :class:`CorruptArtifactError` on mismatch."""
+    want_sha = event.get("sha256")
+    if want_sha is None:
+        return
+    version = int(event.get("version", 0))
+    want_bytes = int(event.get("bytes", -1))
+    if want_bytes >= 0 and len(data) != want_bytes:
+        raise CorruptArtifactError(
+            "artifact v%d truncated: %d bytes, event says %d"
+            % (version, len(data), want_bytes))
+    got = hashlib.sha256(data).hexdigest()
+    if got != want_sha:
+        raise CorruptArtifactError(
+            "artifact v%d sha256 mismatch: %s != %s"
+            % (version, got, want_sha))
+
+
+class StaleLeaseError(LightGBMError):
+    """A fenced publish was refused: the store's lease is no longer held
+    by this trainer at this epoch (another trainer took over)."""
+
+
+class CorruptArtifactError(LightGBMError):
+    """A model artifact failed its publish-event sha256/length check."""
+
 
 class FleetStore:
     """Durable event log + model-artifact directory for one served model.
@@ -55,9 +108,15 @@ class FleetStore:
     the trainer worker (gate/publish); reads come from replica-watcher
     threads and boot-time replay. The in-memory counters exist only for
     cheap ``state()`` snapshots — the file is the source of truth.
+
+    ``orphan_grace_s``: on open, artifact files newer than every publish
+    event (a publisher died between ``os.replace`` and its event append)
+    are reaped — but only when older than this grace, so opening a store
+    never races another process's in-flight publish.
     """
 
-    def __init__(self, root: str, model_id: str = "default") -> None:
+    def __init__(self, root: str, model_id: str = "default", *,
+                 orphan_grace_s: float = 60.0) -> None:
         model_id = str(model_id)
         if not model_id or "/" in model_id or model_id.startswith("."):
             raise LightGBMError("fleet model_id must be a plain name, "
@@ -67,15 +126,24 @@ class FleetStore:
         self._dir = os.path.join(self._root, model_id)
         self._events_path = os.path.join(self._dir, "events.jsonl")
         self._models_dir = os.path.join(self._dir, "models")
+        self._lease_path = os.path.join(self._dir, "lease.json")
         os.makedirs(self._models_dir, exist_ok=True)
-        # guards version allocation and the state counters; file appends
-        # are one-write atomic on their own but publish must allocate the
-        # next version token and write the artifact before its event
-        self._lock = threading.Lock()
-        latest = self._scan_latest_publish()
-        self._last_version = latest["version"] if latest else 0
+        # guards version allocation, the fence, compaction's rewrite and
+        # the state counters; re-entrant because publish/compact append
+        # through the same locked _append as the HTTP ingest path
+        self._lock = threading.RLock()
+        self._fence: Optional[Tuple[str, int]] = None
         self._ingest_rows = 0
         self._publishes = 0
+        self._compactions = 0
+        self._last_compact_ts = 0.0
+        self._orphans_reaped = 0
+        self._stale_seen: set = set()
+        self._corrupt_seen: set = set()
+        self._repair_torn_tail()
+        valid, max_version, _max_epoch, _stale = self._scan_publishes()
+        self._last_version = max_version
+        self._reap_orphans(max_version, float(orphan_grace_s))
 
     # ---------------------------------------------------------------- identity
     @property
@@ -90,12 +158,67 @@ class FleetStore:
     def events_path(self) -> str:
         return self._events_path
 
+    def log_bytes(self) -> int:
+        try:
+            return os.path.getsize(self._events_path)
+        except OSError:
+            return 0
+
     # ----------------------------------------------------------------- append
     def _stamp(self, kind: str, payload: Dict[str, Any]) -> Dict[str, Any]:
         entry = {"v": STORE_VERSION, "kind": kind,
                  "ts": time.time()}  # graftlint: disable=naked-timer -- epoch timestamp, not a duration
         entry.update(payload)
         return entry
+
+    def _repair_torn_tail(self) -> None:
+        """Truncate a partial final line (a writer SIGKILLed mid-append).
+        Readers already skip it, but without the truncation the NEXT
+        append would glue onto the torn prefix and both lines would read
+        back as one corrupt line — a restarted trainer's first event
+        silently lost. Runs once, on open."""
+        try:
+            size = os.path.getsize(self._events_path)
+        except OSError:
+            return
+        if size == 0:
+            return
+        with open(self._events_path, "rb+") as f:
+            f.seek(-1, os.SEEK_END)
+            if f.read(1) == b"\n":
+                return
+            # walk back block-wise to the last complete line's newline
+            pos, keep = size, 0
+            while pos > 0:
+                step = min(4096, pos)
+                pos -= step
+                f.seek(pos)
+                idx = f.read(step).rfind(b"\n")
+                if idx >= 0:
+                    keep = pos + idx + 1
+                    break
+            f.truncate(keep)
+        telemetry.count("fleet/torn_tail_repaired")
+        Log.warning("fleet: truncated %d-byte torn tail line in %s",
+                    size - keep, self._events_path)
+
+    def _append(self, entry: Dict[str, Any]) -> None:
+        """All event appends funnel here: serialized against compaction's
+        atomic rewrite, and carrying the ``store/append`` chaos point (a
+        torn action writes a prefix of the line and raises — the
+        simulated crash the corrupt-line skip on replay recovers from)."""
+        with self._lock:
+            act = chaos.hit("store/append")
+            if act is not None and act[0] == "torn":
+                line = (json.dumps(entry, sort_keys=True)
+                        + "\n").encode("utf-8")
+                cut = max(1, int(len(line) * float(act[1])))
+                with open(self._events_path, "ab") as f:
+                    f.write(line[:cut])
+                raise chaos.InjectedFault(
+                    "torn append (%d/%d bytes) at %s"
+                    % (cut, len(line), entry.get("kind")))
+            append_jsonl(self._events_path, entry)
 
     def append_ingest(self, X, y) -> None:
         """Persist one labeled traffic chunk (one JSONL line). Called on
@@ -105,7 +228,7 @@ class FleetStore:
         if X.ndim == 1:
             X = X[None, :]
         y = np.asarray(y, np.float64).ravel()
-        append_jsonl(self._events_path, self._stamp("ingest", {
+        self._append(self._stamp("ingest", {
             "n": int(len(y)), "rows": X.tolist(), "labels": y.tolist()}))
         with self._lock:
             self._ingest_rows += int(len(y))
@@ -118,10 +241,151 @@ class FleetStore:
         trainer must resume), and the consumed-row watermark (rows
         ingested before it are already trained — replay keeps them out
         of the training buffer but in the shadow window)."""
-        append_jsonl(self._events_path, self._stamp("gate", {
+        self._append(self._stamp("gate", {
             "result": str(result), "wins": int(wins),
             "consumed_rows": int(consumed_rows),
             "losses": losses}))
+
+    # ------------------------------------------------------------------ lease
+    def _read_lease(self) -> Optional[Dict[str, Any]]:
+        try:
+            with open(self._lease_path, "r", encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            return None
+        return doc if isinstance(doc, dict) else None
+
+    def _write_lease(self, doc: Dict[str, Any]) -> None:
+        chaos.hit("store/lease")
+        tmp = self._lease_path + ".tmp.%d" % os.getpid()
+        data = json.dumps(doc, sort_keys=True).encode("utf-8")
+        fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
+        try:
+            done = 0
+            while done < len(data):
+                done += os.write(fd, data[done:])
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+        os.replace(tmp, self._lease_path)
+
+    def _guard_acquire(self) -> bool:
+        """O_EXCL guard file serializing lease read-modify-write across
+        processes; a guard left by a crashed acquirer is broken after
+        ``_GUARD_STALE_S``. Returns False when another acquirer is live
+        right now (the caller treats that as lease-unavailable)."""
+        path = self._lease_path + ".lock"
+        for _ in range(2):
+            try:
+                fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_EXCL,
+                             0o644)
+            except FileExistsError:
+                try:
+                    age = time.time() - os.path.getmtime(path)  # graftlint: disable=naked-timer -- epoch timestamp, not a duration
+                except OSError:
+                    continue
+                if age > _GUARD_STALE_S:
+                    try:
+                        os.unlink(path)
+                    except OSError:
+                        pass
+                    continue
+                return False
+            os.write(fd, b"%d" % os.getpid())
+            os.close(fd)
+            return True
+        return False
+
+    def _guard_release(self) -> None:
+        try:
+            os.unlink(self._lease_path + ".lock")
+        except OSError:
+            pass
+
+    def acquire_lease(self, holder: str, ttl_s: float) -> Optional[int]:
+        """Try to take the trainer lease. Returns the new fencing epoch,
+        or None while another live holder has it. EVERY successful
+        acquisition — takeover of an expired lease, or re-acquisition by
+        the same holder — bumps the epoch, so an epoch uniquely names
+        one continuous tenure."""
+        holder = str(holder)
+        if ttl_s <= 0:
+            raise LightGBMError("lease ttl_s must be > 0, got %g" % ttl_s)
+        with self._lock:
+            if not self._guard_acquire():
+                return None
+            try:
+                cur = self._read_lease()
+                now = time.time()  # graftlint: disable=naked-timer -- epoch timestamp, not a duration
+                if (cur is not None and cur.get("holder") != holder
+                        and float(cur.get("expires_ts", 0.0)) > now):
+                    return None
+                epoch = int(cur.get("epoch", 0)) + 1 if cur else 1
+                self._write_lease({
+                    "v": STORE_VERSION, "holder": holder, "epoch": epoch,
+                    "expires_ts": now + float(ttl_s), "acquired_ts": now,
+                    "pid": os.getpid()})
+            finally:
+                self._guard_release()
+        telemetry.count("fleet/lease_acquired")
+        telemetry.gauge("fleet/lease_epoch", epoch)
+        Log.info("fleet: %s acquired trainer lease (epoch %d, ttl %gs)",
+                 holder, epoch, ttl_s)
+        return epoch
+
+    def renew_lease(self, holder: str, epoch: int, ttl_s: float) -> bool:
+        """Heartbeat: extend the lease iff still held by ``holder`` at
+        ``epoch``. An expired-but-untaken lease renews fine (the holder
+        merely heartbeat late); a lease re-acquired by anyone (epoch
+        moved on) does not — the caller must demote to standby."""
+        with self._lock:
+            cur = self._read_lease()
+            if (cur is None or cur.get("holder") != str(holder)
+                    or int(cur.get("epoch", -1)) != int(epoch)):
+                return False
+            now = time.time()  # graftlint: disable=naked-timer -- epoch timestamp, not a duration
+            cur["expires_ts"] = now + float(ttl_s)
+            self._write_lease(cur)
+        return True
+
+    def release_lease(self, holder: str, epoch: int) -> bool:
+        """Clean handoff: expire the lease immediately (epoch kept, so
+        the next acquirer still bumps past it). No-op unless still held
+        by ``holder`` at ``epoch``."""
+        with self._lock:
+            cur = self._read_lease()
+            if (cur is None or cur.get("holder") != str(holder)
+                    or int(cur.get("epoch", -1)) != int(epoch)):
+                return False
+            cur["expires_ts"] = 0.0
+            cur["released_ts"] = time.time()  # graftlint: disable=naked-timer -- epoch timestamp, not a duration
+            self._write_lease(cur)
+        return True
+
+    def lease_state(self) -> Dict[str, Any]:
+        """JSON-serializable lease summary (surfaced on /healthz)."""
+        cur = self._read_lease()
+        if cur is None:
+            return {"held": False, "holder": None, "epoch": 0,
+                    "expires_ts": 0.0}
+        expires = float(cur.get("expires_ts", 0.0))
+        return {
+            "held": expires > time.time(),  # graftlint: disable=naked-timer -- epoch timestamp, not a duration
+            "holder": cur.get("holder"),
+            "epoch": int(cur.get("epoch", 0)),
+            "expires_ts": expires,
+        }
+
+    def set_fence(self, holder: str, epoch: int) -> None:
+        """Arm publish fencing: every later :meth:`publish` re-checks the
+        lease against this (holder, epoch) and stamps the epoch into the
+        publish event."""
+        with self._lock:
+            self._fence = (str(holder), int(epoch))
+
+    def clear_fence(self) -> None:
+        with self._lock:
+            self._fence = None
 
     # ---------------------------------------------------------------- publish
     def publish(self, model_str: str, event: str = "promotion",
@@ -130,17 +394,40 @@ class FleetStore:
 
         The artifact is written to a temp path and ``os.replace``d (atomic
         on POSIX) before the publish event is appended — a watcher that
-        sees the event can always read the complete artifact. Returns the
+        sees the event can always read the complete artifact. The event
+        carries the artifact's sha256 + byte length (verified by
+        :meth:`load_publish`) and the publisher's fencing epoch. When a
+        fence is armed and the lease moved on, raises
+        :class:`StaleLeaseError` BEFORE anything is written. Returns the
         allocated version token."""
         if event not in PUBLISH_EVENTS:
             raise LightGBMError("publish event must be one of %s, got %r"
                                 % ("|".join(PUBLISH_EVENTS), event))
         with self._lock:
+            epoch = 0
+            if self._fence is not None:
+                lease = self._read_lease()
+                if (lease is None
+                        or lease.get("holder") != self._fence[0]
+                        or int(lease.get("epoch", -1)) != self._fence[1]):
+                    telemetry.count("fleet/stale_publishes_blocked")
+                    raise StaleLeaseError(
+                        "publish fenced off: lease now %r, this trainer "
+                        "held %r" % (lease, self._fence))
+                epoch = self._fence[1]
+            # a previous active trainer (another process, another store
+            # instance over the same dir) may have published since this
+            # store was opened: re-read the allocation floor from the log
+            # so a standby that takes over never reuses a version token
+            _valid, max_version, _maxe, _stale = self._scan_publishes()
+            if max_version > self._last_version:
+                self._last_version = max_version
             version = self._last_version + 1
             name = _ARTIFACT_FMT % version
             final = os.path.join(self._models_dir, name)
             tmp = final + ".tmp.%d" % os.getpid()
-            view = memoryview(model_str.encode("utf-8"))
+            data = model_str.encode("utf-8")
+            view = memoryview(data)
             fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
             try:
                 done = 0
@@ -150,13 +437,19 @@ class FleetStore:
             finally:
                 os.close(fd)
             os.replace(tmp, final)
-            append_jsonl(self._events_path, self._stamp("publish", {
+            # the crash-between-replace-and-event window orphan reaping
+            # covers; a ("raise",...) action here leaves exactly that
+            chaos.hit("store/publish")
+            self._append(self._stamp("publish", {
                 "version": version, "artifact": name, "event": event,
+                "sha256": hashlib.sha256(data).hexdigest(),
+                "bytes": len(data), "lease_epoch": epoch,
                 "meta": dict(meta) if meta else None}))
             self._last_version = version
             self._publishes += 1
         telemetry.count("fleet/publishes")
         telemetry.gauge("fleet/published_version", version)
+        telemetry.gauge("fleet/events_log_bytes", self.log_bytes())
         return version
 
     # ------------------------------------------------------------------ read
@@ -166,40 +459,289 @@ class FleetStore:
             if kind is None or e.get("kind") == kind:
                 yield e
 
-    def _scan_latest_publish(self) -> Optional[Dict[str, Any]]:
-        latest: Optional[Dict[str, Any]] = None
-        for e in self.events("publish"):
+    def _scan_publishes(self) -> Tuple[List[Dict[str, Any]], int, int,
+                                       List[Dict[str, Any]]]:
+        """One pass over the log → (valid publishes in append order,
+        max version over ALL publishes incl. stale + compact floor,
+        max epoch, stale publishes).
+
+        A publish is STALE when its lease epoch is below an epoch already
+        seen earlier in the log — a zombie trainer's write that raced the
+        fence. Stale versions still raise the allocation floor (tokens
+        are never reused) but are never applied. Compact records carry
+        the floors for everything they truncated."""
+        valid: List[Dict[str, Any]] = []
+        stale: List[Dict[str, Any]] = []
+        max_version = 0
+        max_epoch = 0
+        for e in self.events():
+            kind = e.get("kind")
+            if kind == "compact":
+                max_version = max(max_version,
+                                  int(e.get("last_version", 0)))
+                max_epoch = max(max_epoch, int(e.get("lease_epoch", 0)))
+                continue
+            if kind != "publish":
+                continue
             v = e.get("version")
-            if isinstance(v, int) and (latest is None
-                                       or v > latest["version"]):
-                latest = e
-        return latest
+            if not isinstance(v, int):
+                continue
+            max_version = max(max_version, v)
+            epoch = int(e.get("lease_epoch", 0))
+            if epoch < max_epoch:
+                stale.append(e)
+                continue
+            max_epoch = max(max_epoch, epoch)
+            valid.append(e)
+        if stale:
+            with self._lock:
+                fresh = [e for e in stale
+                         if e["version"] not in self._stale_seen]
+                self._stale_seen.update(e["version"] for e in fresh)
+            if fresh:
+                telemetry.count("fleet/stale_publishes_rejected",
+                                len(fresh))
+                Log.warning(
+                    "fleet: rejected %d stale-epoch publish(es): %s",
+                    len(fresh),
+                    ", ".join("v%d@e%d" % (e["version"],
+                                           int(e.get("lease_epoch", 0)))
+                              for e in fresh))
+        return valid, max_version, max_epoch, stale
 
     def latest_publish(self) -> Optional[Dict[str, Any]]:
-        """Newest publish event whose artifact exists on disk, or None.
-        Re-reads the log, so a replica polling this sees other
-        processes' publishes."""
-        latest = self._scan_latest_publish()
-        if latest is None:
+        """Newest valid (non-stale-epoch) publish event whose artifact
+        exists on disk, or None. Re-reads the log, so a replica polling
+        this sees other processes' publishes."""
+        valid, max_version, _max_epoch, _stale = self._scan_publishes()
+        if not valid:
             return None
+        latest = valid[-1]
         if not os.path.exists(self.artifact_path(latest["version"])):
             return None
         with self._lock:
-            if latest["version"] > self._last_version:
-                self._last_version = latest["version"]
+            if max_version > self._last_version:
+                self._last_version = max_version
         return latest
+
+    def latest_valid_publish(self, min_version: int = 0
+                             ) -> Optional[Tuple[Dict[str, Any], str]]:
+        """Newest publish (newer than ``min_version``) whose artifact
+        verifies against the event's sha256/length — walking back past
+        corrupt or missing artifacts to the previous good publish, each
+        counted once per version under ``fleet/corrupt_artifacts``.
+        Returns (event, model_str) or None."""
+        valid, _maxv, _maxe, _stale = self._scan_publishes()
+        for e in reversed(valid):
+            version = int(e["version"])
+            if version <= int(min_version):
+                break
+            try:
+                return e, self.load_publish(e)
+            except (CorruptArtifactError, OSError) as exc:
+                with self._lock:
+                    seen = version in self._corrupt_seen
+                    self._corrupt_seen.add(version)
+                if not seen:
+                    telemetry.count("fleet/corrupt_artifacts")
+                    Log.warning("fleet: skipping publish v%d (%s: %s); "
+                                "falling back to previous good publish",
+                                version, type(exc).__name__, exc)
+        return None
 
     def artifact_path(self, version: int) -> str:
         return os.path.join(self._models_dir, _ARTIFACT_FMT % int(version))
 
+    def _read_artifact(self, version: int) -> bytes:
+        act = chaos.hit("store/artifact_read")
+        with open(self.artifact_path(version), "rb") as f:
+            data = f.read()
+        if act is not None and act[0] == "torn":
+            data = data[:int(len(data) * float(act[1]))]
+        return data
+
     def load_model(self, version: int) -> str:
-        """The whole-model string published under ``version``."""
-        with open(self.artifact_path(version), "r", encoding="utf-8") as f:
-            return f.read()
+        """The whole-model string published under ``version`` — raw read,
+        no checksum (prefer :meth:`load_publish`)."""
+        return self._read_artifact(version).decode("utf-8")
+
+    def load_publish(self, event: Dict[str, Any]) -> str:
+        """Read the artifact behind one publish event, verifying the
+        event's sha256 + byte length when present. Raises
+        :class:`CorruptArtifactError` on mismatch."""
+        data = self._read_artifact(int(event["version"]))
+        _verify_artifact(event, data)
+        return data.decode("utf-8")
 
     def publishes(self) -> List[Dict[str, Any]]:
-        """All publish events oldest-first."""
-        return list(self.events("publish"))
+        """Valid (non-stale-epoch) publish events oldest-first."""
+        valid, _maxv, _maxe, _stale = self._scan_publishes()
+        return valid
+
+    # ---------------------------------------------------------------- orphans
+    def _reap_orphans(self, max_version: int, grace_s: float) -> None:
+        """Delete artifact files no publish event references (a publisher
+        died between the artifact ``os.replace`` and its event append)
+        plus stray ``*.tmp.*`` files — both only when older than
+        ``grace_s``, so opening a store never races a live publish."""
+        now = time.time()  # graftlint: disable=naked-timer -- epoch timestamp, not a duration
+        reaped = 0
+        try:
+            names = os.listdir(self._models_dir)
+        except OSError:
+            return
+        for name in names:
+            path = os.path.join(self._models_dir, name)
+            orphan = False
+            if ".tmp." in name:
+                orphan = True
+            elif name.startswith("v") and name.endswith(".txt"):
+                try:
+                    orphan = int(name[1:-4]) > max_version
+                except ValueError:
+                    continue
+            if not orphan:
+                continue
+            try:
+                if now - os.path.getmtime(path) < grace_s:
+                    continue
+                os.unlink(path)
+                reaped += 1
+            except OSError:
+                continue
+        if reaped:
+            self._orphans_reaped = reaped
+            telemetry.count("fleet/orphan_artifacts_reaped", reaped)
+            Log.info("fleet: reaped %d orphan artifact file(s) in %s",
+                     reaped, self._models_dir)
+
+    # ------------------------------------------------------------- compaction
+    def compact(self, *, watermark: int, wins: int, keep_rows: int,
+                keep_artifacts: int = 0) -> Dict[str, Any]:
+        """Snapshot trainer state and truncate the replayed prefix.
+
+        Writes one ``compact`` record carrying the gate snapshot
+        (``watermark``/``wins`` — standing in for every dropped gate
+        event), the global row offset of the first retained ingest
+        (``row_base``), and the version/epoch floors for dropped
+        publishes; then atomically rewrites ``events.jsonl`` as
+        [compact record] + retained publishes + retained ingests.
+
+        Retention keeps every ingest chunk with rows above ``watermark``
+        (still-unconsumed training traffic) plus the maximal contiguous
+        suffix of earlier chunks totalling ≤ ``keep_rows`` rows — because
+        the shadow window drops oldest-first chunk-wise, replaying any
+        suffix that covers its final content reproduces it bit-for-bit
+        (pinned in tests/test_failover.py, including a compaction landing
+        mid-shadow-window). Pass the shadow window's capacity as
+        ``keep_rows``.
+
+        ``keep_artifacts`` > 0 additionally retains only that many newest
+        publish events and deletes the older artifact files; 0 keeps all.
+        Returns a summary dict. Must run in the (single) writer process —
+        in-process appends are serialized against the rewrite by the
+        store lock."""
+        with self._lock:
+            events = list(self.events())
+            row_base = 0
+            last_version = 0
+            lease_epoch = 0
+            ingests: List[Tuple[int, int, Dict[str, Any]]] = []
+            publishes: List[Dict[str, Any]] = []
+            seen = None
+            for e in events:
+                kind = e.get("kind")
+                if kind == "compact":
+                    base = int(e.get("row_base", 0))
+                    seen = base if seen is None else seen
+                    row_base = base
+                    last_version = max(last_version,
+                                       int(e.get("last_version", 0)))
+                    lease_epoch = max(lease_epoch,
+                                      int(e.get("lease_epoch", 0)))
+                elif kind == "ingest":
+                    lo = row_base if seen is None else seen
+                    seen = lo + int(e.get("n", 0))
+                    ingests.append((lo, seen, e))
+                elif kind == "publish":
+                    v = e.get("version")
+                    if isinstance(v, int):
+                        last_version = max(last_version, v)
+                        lease_epoch = max(lease_epoch,
+                                          int(e.get("lease_epoch", 0)))
+                    publishes.append(e)
+            total_rows = ingests[-1][1] if ingests else row_base
+            # retained = mandatory unconsumed suffix + shadow-cover suffix
+            keep_from = len(ingests)
+            acc = 0
+            for i in range(len(ingests) - 1, -1, -1):
+                lo, hi, e = ingests[i]
+                n = int(e.get("n", 0))
+                if hi > int(watermark) or acc + n <= int(keep_rows):
+                    acc += n
+                    keep_from = i
+                else:
+                    break
+            kept_ingests = ingests[keep_from:]
+            new_row_base = kept_ingests[0][0] if kept_ingests else total_rows
+            kept_publishes = publishes
+            dropped_artifacts = 0
+            if int(keep_artifacts) > 0:
+                kept_publishes = publishes[-int(keep_artifacts):]
+                kept_versions = {int(e["version"]) for e in kept_publishes
+                                 if isinstance(e.get("version"), int)}
+                for e in publishes:
+                    v = e.get("version")
+                    if isinstance(v, int) and v not in kept_versions:
+                        try:
+                            os.unlink(self.artifact_path(v))
+                            dropped_artifacts += 1
+                        except OSError:
+                            pass
+            record = self._stamp("compact", {
+                "watermark": int(watermark), "wins": int(wins),
+                "row_base": int(new_row_base),
+                "last_version": int(last_version),
+                "lease_epoch": int(lease_epoch),
+                "dropped_events": len(events) - len(kept_ingests)
+                - len(kept_publishes),
+                "dropped_rows": int(new_row_base - row_base)})
+            lines = [record] + kept_publishes + [e for _, _, e in
+                                                kept_ingests]
+            tmp = self._events_path + ".tmp.%d" % os.getpid()
+            data = "".join(json.dumps(entry, sort_keys=True) + "\n"
+                           for entry in lines).encode("utf-8")
+            fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
+            try:
+                view = memoryview(data)
+                done = 0
+                while done < len(view):
+                    done += os.write(fd, view[done:])
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+            os.replace(tmp, self._events_path)
+            self._compactions += 1
+            self._last_compact_ts = record["ts"]
+            if last_version > self._last_version:
+                self._last_version = last_version
+        telemetry.count("fleet/compactions")
+        telemetry.count("fleet/compacted_events",
+                        max(0, int(record["dropped_events"])))
+        telemetry.count("fleet/compacted_rows",
+                        max(0, int(record["dropped_rows"])))
+        telemetry.gauge("fleet/events_log_bytes", self.log_bytes())
+        telemetry.gauge("fleet/last_compaction_ts", record["ts"])
+        Log.info("fleet: compacted %s: dropped %d event(s) / %d row(s) "
+                 "/ %d artifact(s), kept %d ingest + %d publish",
+                 self._model_id, record["dropped_events"],
+                 record["dropped_rows"], dropped_artifacts,
+                 len(kept_ingests), len(kept_publishes))
+        return {"dropped_events": record["dropped_events"],
+                "dropped_rows": record["dropped_rows"],
+                "dropped_artifacts": dropped_artifacts,
+                "row_base": int(new_row_base),
+                "log_bytes": self.log_bytes()}
 
     # ------------------------------------------------------------------ state
     def state(self) -> Dict[str, Any]:
@@ -211,4 +753,9 @@ class FleetStore:
                 "last_published_version": self._last_version,
                 "publishes_this_process": self._publishes,
                 "ingest_rows_persisted": self._ingest_rows,
+                "lease": self.lease_state(),
+                "events_log_bytes": self.log_bytes(),
+                "compactions": self._compactions,
+                "last_compaction_ts": self._last_compact_ts,
+                "orphan_artifacts_reaped": self._orphans_reaped,
             }

@@ -20,12 +20,12 @@ def dotted(node: ast.AST) -> str:
     return ""
 
 
-def import_aliases(tree: ast.Module) -> Dict[str, str]:
+def import_aliases(tree: ast.Module, nodes=None) -> Dict[str, str]:
     """Local name -> canonical dotted target, from this module's imports
     (``import numpy as np`` -> {'np': 'numpy'}; ``from time import
     perf_counter as pc`` -> {'pc': 'time.perf_counter'})."""
     out: Dict[str, str] = {}
-    for node in ast.walk(tree):
+    for node in (ast.walk(tree) if nodes is None else nodes):
         if isinstance(node, ast.Import):
             for a in node.names:
                 out[a.asname or a.name.split(".")[0]] = (
@@ -42,7 +42,11 @@ def import_aliases_cached(f) -> Dict[str, str]:
     walk behind it is a measurable slice of the <5s lint budget."""
     cached = f.__dict__.get("_lint_aliases")
     if cached is None:
-        cached = f.__dict__["_lint_aliases"] = import_aliases(f.tree)
+        # the SourceFile already materializes its full node list; reuse
+        # it so the alias scan is a list pass, not a second tree walk
+        walk = getattr(f, "walk_nodes", None)
+        cached = f.__dict__["_lint_aliases"] = import_aliases(
+            f.tree, walk() if walk is not None else None)
     return cached
 
 
@@ -100,6 +104,17 @@ def own_walk(node) -> Iterator[ast.AST]:
         if isinstance(n, _OWN_SKIP):
             continue
         _children(n, stack)
+
+
+def own_walk_cached(node) -> List[ast.AST]:
+    """Materialized :func:`own_walk`, cached on the node itself: both
+    engine graph builds and three graph-based rules re-walk the same
+    function bodies, and one list beats six generator walks inside the
+    <5s full-lint budget (same idiom as ``SourceFile.walk_nodes``)."""
+    cached = getattr(node, "_lint_own_walk", None)
+    if cached is None:
+        cached = node._lint_own_walk = list(own_walk(node))
+    return cached
 
 
 def call_name_args(node: ast.Call) -> Iterator[ast.Name]:

@@ -37,7 +37,7 @@ import ast
 from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple
 
 from .astutil import (call_name_args, canonical_call, dotted,
-                      import_aliases_cached, own_walk)
+                      import_aliases_cached, own_walk_cached)
 
 #: jit / partial wrapper heads (entries by value)
 JIT_HEADS = {"jax.jit", "jit"}
@@ -359,7 +359,7 @@ class ProjectGraph:
         self._mod_assigns: Dict[str, List[Tuple[str, ast.AST]]] = {}
         for f in self.files:
             pairs: List[Tuple[str, ast.AST]] = []
-            for node in own_walk(f.tree):
+            for node in own_walk_cached(f.tree):
                 if isinstance(node, ast.Assign) \
                         and len(node.targets) == 1 \
                         and isinstance(node.targets[0], ast.Name):
@@ -376,7 +376,7 @@ class ProjectGraph:
             attrs: List[Tuple[str, Optional[ast.AST]]] = []
             rets: List[ast.AST] = []
             calls: List[ast.Call] = []
-            for node in own_walk(fn.node):
+            for node in own_walk_cached(fn.node):
                 if isinstance(node, (ast.Assign, ast.AnnAssign)):
                     targets = node.targets \
                         if isinstance(node, ast.Assign) else [node.target]
@@ -525,7 +525,7 @@ class ProjectGraph:
         if owner is not None:
             calls = self._fn_facts[id(owner)][3]
         else:
-            calls = [n for n in own_walk(body)
+            calls = [n for n in own_walk_cached(body)
                      if isinstance(n, ast.Call)]
         for node in calls:
             cname = canonical_call(node, aliases)
@@ -588,7 +588,7 @@ class ProjectGraph:
             scopes: List[Tuple[Optional[FuncInfo], ast.AST]] = [(None, f.tree)]
             scopes += [(fn, fn.node) for fn in self.funcs if fn.file is f]
             for owner, body in scopes:
-                for node in own_walk(body):
+                for node in own_walk_cached(body):
                     if not isinstance(node, ast.Call):
                         continue
                     cname = canonical_call(node, aliases)

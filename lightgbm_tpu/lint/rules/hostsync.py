@@ -9,7 +9,7 @@ from __future__ import annotations
 import ast
 from typing import Dict, Iterator, Optional
 
-from ..astutil import canonical_call, dotted, own_walk
+from ..astutil import canonical_call, dotted, own_walk_cached
 from ..core import Finding, Project, Rule, register
 from ..graph import graph_for
 
@@ -66,7 +66,7 @@ class HostSyncRule(Rule):
             if id(fn) not in hot:
                 continue
             aliases = g.aliases[fn.file.rel]
-            for node in own_walk(fn.node):
+            for node in own_walk_cached(fn.node):
                 if not isinstance(node, ast.Call):
                     continue
                 hit = self._sync_kind(node, aliases)

@@ -53,7 +53,7 @@ import re
 from typing import Dict, FrozenSet, Iterator, List, Optional, Set, Tuple
 
 from ..astutil import canonical_call, import_aliases_cached, kwarg_names, \
-    own_walk
+    own_walk_cached
 from ..core import Finding, Project, Rule, SourceFile, register
 from ..graph import EXT, FuncInfo, ProjectGraph, graph_for
 
@@ -294,7 +294,7 @@ class LockDisciplineRule(Rule):
 
             # pre-pass: one-level aliases (order-free; fresh locals come
             # from the engine — same set the confined-edge cut uses)
-            for node in own_walk(fn.node):
+            for node in own_walk_cached(fn.node):
                 if not isinstance(node, ast.Assign):
                     continue
                 names = [t.id for t in node.targets
@@ -326,7 +326,7 @@ class LockDisciplineRule(Rule):
                     alias.setdefault(n, set()).update(atkeys)
 
             # main pass: reads, writes, mutations
-            for node in own_walk(fn.node):
+            for node in own_walk_cached(fn.node):
                 if isinstance(node, ast.Assign):
                     for t in node.targets:
                         if isinstance(t, ast.Attribute):

@@ -12,7 +12,7 @@ from __future__ import annotations
 import ast
 from typing import Dict, Iterator, List, Set
 
-from ..astutil import canonical_call, own_walk
+from ..astutil import canonical_call, own_walk_cached
 from ..core import Finding, Project, Rule, register
 from ..graph import FuncInfo, graph_for
 from .hostsync import hot_subset
@@ -99,7 +99,7 @@ class TracerLeakRule(Rule):
         # DIRECTLY (not inside a shape tuple or static kwarg) to a jnp/lax
         # call somewhere in this function
         evidence: Set[str] = set()
-        for node in own_walk(fn.node):
+        for node in own_walk_cached(fn.node):
             if isinstance(node, ast.Call) \
                     and _jaxish(canonical_call(node, aliases)):
                 direct = list(node.args) \

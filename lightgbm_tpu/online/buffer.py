@@ -109,7 +109,31 @@ class TrafficBuffer:
         return (np.concatenate([c[0] for c in chunks], axis=0),
                 np.concatenate([c[1] for c in chunks]))
 
+    def reset(self) -> None:
+        """Forget everything (both windows, the drop/total counters). A
+        standby trainer taking over a lease calls this before replaying
+        the store, so the rebuilt state comes from the log alone."""
+        with self._lock:
+            self._chunks.clear()
+            self._rows = 0
+            self._shadow.clear()
+            self._shadow_held = 0
+            self._dropped = 0
+            self._total = 0
+
     # --------------------------------------------------------------- state
+    @property
+    def capacity(self) -> int:
+        return self._cap
+
+    @property
+    def shadow_capacity(self) -> int:
+        """The shadow window's row bound — also the compaction retention
+        floor (``FleetStore.compact(keep_rows=...)``): retaining this
+        many replayed rows is sufficient to rebuild the window
+        bit-identically."""
+        return self._shadow_cap
+
     @property
     def rows(self) -> int:
         """Rows currently buffered for the next train cycle."""

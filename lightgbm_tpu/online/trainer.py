@@ -38,6 +38,21 @@ Fleet extensions (PR 11), all off by default:
   the training buffer would double-train), rows above it re-enter both,
   and the hysteresis win-streak resumes where the dead process left it.
 
+Failover (PR 13), also off by default: with ``lease_ttl_s`` > 0 the
+trainer starts in STANDBY — it persists ingest but neither buffers nor
+trains — until it wins the store's trainer lease
+(:meth:`~lightgbm_tpu.fleet.store.FleetStore.acquire_lease`). On
+acquisition it arms publish fencing with its lease epoch, rebuilds its
+state through the replay-on-boot path (so a standby taking over a dead
+holder resumes the identical watermark/win-streak), and goes active;
+the worker then heartbeats the lease every ttl/3 and demotes itself
+back to standby the moment a renewal fails — from which point the
+fencing epoch guarantees its publishes are rejected even if it believes
+it is still primary. ``compact_bytes`` > 0 additionally compacts the
+store (snapshot + truncate, ``FleetStore.compact``) whenever the event
+log outgrows that bound, after the gate verdict that made the state
+durable.
+
 Telemetry: ``online/ingested_rows``, ``online/train_runs``,
 ``online/promotions``, ``online/rejections``, ``online/train_errors``
 counters; ``online/train_ms``, ``online/shadow_ms``,
@@ -47,6 +62,7 @@ recorder (domain ``online`` records whenever the serve chain does).
 """
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
@@ -200,6 +216,10 @@ class OnlineTrainer:
                  rollback_threshold: float = 0.0,
                  rollback_min_rows: int = 64,
                  store=None, replay: bool = True,
+                 lease_ttl_s: float = 0.0,
+                 holder_id: Optional[str] = None,
+                 compact_bytes: int = 0,
+                 keep_artifacts: int = 0,
                  candidate_factory=None,
                  start: bool = True) -> None:
         if mode not in MODES:
@@ -225,6 +245,19 @@ class OnlineTrainer:
             raise LightGBMError("online trigger_rows must be >= 1")
         if promote_threshold < 0:
             raise LightGBMError("online promote_threshold must be >= 0")
+        if lease_ttl_s < 0:
+            raise LightGBMError("online lease_ttl_s must be >= 0 "
+                                "(0 disables failover leasing), got %g"
+                                % lease_ttl_s)
+        if compact_bytes < 0 or keep_artifacts < 0:
+            raise LightGBMError("online compact_bytes/keep_artifacts "
+                                "must be >= 0")
+        if lease_ttl_s > 0 and store is None:
+            raise LightGBMError("online lease_ttl_s needs a fleet store "
+                                "to hold the lease in")
+        if compact_bytes > 0 and store is None:
+            raise LightGBMError("online compact_bytes needs a fleet "
+                                "store to compact")
         self._booster = booster
         self._mode = mode
         self._trigger_rows = int(trigger_rows)
@@ -238,9 +271,16 @@ class OnlineTrainer:
         self._rb_threshold = float(rollback_threshold)
         self._rb_min_rows = int(rollback_min_rows)
         # the fleet store is duck-typed (append_ingest/append_gate/
-        # publish/events) so the trainer stays importable without the
-        # fleet package and tests can inject fakes
+        # publish/events, plus acquire/renew/release_lease + compact when
+        # the failover/retention knobs are on) so the trainer stays
+        # importable without the fleet package and tests can inject fakes
         self._store = store
+        self._lease_ttl = float(lease_ttl_s)
+        self._holder = str(holder_id) if holder_id \
+            else "pid-%d" % os.getpid()
+        self._compact_bytes = int(compact_bytes)
+        self._keep_artifacts = int(keep_artifacts)
+        self._replay_on_acquire = bool(replay)
         # test/extension hook: a callable (X, y) -> Booster replaces the
         # default candidate build (degraded-candidate gate tests)
         self._candidate_factory = candidate_factory
@@ -289,7 +329,14 @@ class OnlineTrainer:
         self._last_rollback_ts = 0.0
         self._watch: Optional[Dict[str, Any]] = None
         self._watch_chunks: List[Tuple[np.ndarray, np.ndarray]] = []
-        if self._store is not None and replay:
+        # failover: with a lease ttl the trainer boots in STANDBY (no
+        # replay, no training) until it wins the lease — try_acquire()
+        # then replays and goes active with fencing armed
+        self._standby = self._lease_ttl > 0
+        self._lease_epoch = 0
+        self._lease_lost = 0
+        self._last_renew_t = obs.monotonic()
+        if self._store is not None and replay and not self._standby:
             self._replay()
         # pre-touch the promotion counters so a freshly-started online
         # server exposes the whole family on /metrics before the first
@@ -319,6 +366,14 @@ class OnlineTrainer:
         y_arr = np.asarray(y, np.float64).ravel()
         if self._store is not None:
             self._store.append_ingest(X_arr, y_arr)
+        with self._lock:
+            standby = self._standby
+        if standby:
+            # a standby must not accumulate local state: on takeover it
+            # rebuilds everything from the log (which just got this
+            # chunk), so buffering here would double-count it
+            telemetry.count("online/ingested_rows", int(y_arr.size))
+            return 0
         buffered = self.buffer.push(X_arr, y_arr)
         self._feed_watch(X_arr, y_arr)
         telemetry.count("online/ingested_rows", int(y_arr.size))
@@ -355,17 +410,35 @@ class OnlineTrainer:
         process, so they re-enter ONLY the shadow window (training on
         them again would double-count their gradient signal); rows above
         it re-enter the training buffer too. The win-streak resumes from
-        the newest gate event."""
+        the newest gate event.
+
+        A ``compact`` record stands in for everything truncated before
+        it: its watermark/wins snapshot seeds the gate fold, and its
+        ``row_base`` seeds the global row offset so the retained ingest
+        suffix replays at the same offsets it originally held — replay
+        from a compacted log is bit-identical to the full log (pinned in
+        tests/test_failover.py)."""
         events = list(self._store.events())
         watermark = 0
+        wins = 0
         for e in events:
-            if e.get("kind") == "gate":
+            kind = e.get("kind")
+            if kind == "compact":
+                watermark = max(watermark, int(e.get("watermark", 0)))
+                wins = int(e.get("wins", 0))
+            elif kind == "gate":
                 watermark = max(watermark, int(e.get("consumed_rows", 0)))
-                self._wins = int(e.get("wins", 0))
+                wins = int(e.get("wins", 0))
+        with self._lock:
+            self._wins = wins
         seen = 0
         replayed = 0
         for e in events:
-            if e.get("kind") != "ingest":
+            kind = e.get("kind")
+            if kind == "compact":
+                seen = max(seen, int(e.get("row_base", 0)))
+                continue
+            if kind != "ingest":
                 continue
             try:
                 X = np.asarray(e["rows"], np.float64)
@@ -389,13 +462,15 @@ class OnlineTrainer:
                 self.buffer.push(X[:cut], y[:cut], training=False)
                 self.buffer.push(X[cut:], y[cut:])
             replayed += len(y)
-        self._consumed_rows = min(watermark, seen)
-        self._replayed_rows = replayed
+        with self._lock:
+            self._consumed_rows = min(watermark, seen)
+            self._replayed_rows = replayed
+            wins_now = self._wins
         if replayed:
             telemetry.count("fleet/replayed_rows", replayed)
             Log.info("fleet: replayed %d ingest rows (%d shadow-only at "
                      "watermark %d), win-streak=%d", replayed,
-                     min(watermark, seen), watermark, self._wins)
+                     min(watermark, seen), watermark, wins_now)
 
     # --------------------------------------------------------------- worker
     def _worker(self) -> None:
@@ -403,6 +478,10 @@ class OnlineTrainer:
         # tick — row triggers arrive via notify so the tick only bounds
         # shutdown latency
         poll = self._interval if self._interval > 0 else 0.5
+        if self._lease_ttl > 0:
+            # the heartbeat must fire well inside the ttl no matter how
+            # coarse the train trigger is
+            poll = min(poll, self._lease_ttl / 3.0)
         while True:
             with self._lock:
                 if self._stopped:
@@ -410,6 +489,8 @@ class OnlineTrainer:
                 self._lock.wait(timeout=poll)
                 if self._stopped:
                     return
+            if self._lease_ttl > 0 and not self._lease_tick():
+                continue   # standby (or just demoted): no watch, no train
             try:
                 # the live watch outranks training: a regressed model
                 # should be rolled back before another cycle builds a
@@ -446,15 +527,109 @@ class OnlineTrainer:
             return obs.monotonic() - last >= self._interval
         return False
 
+    # --------------------------------------------------------------- failover
+    def try_acquire(self) -> bool:
+        """One lease-acquisition attempt. On success: arm publish
+        fencing with the new epoch, rebuild state from the log through
+        the replay path (the identical watermark/win-streak the dead
+        holder had made durable), go active. Returns True when this
+        trainer is (now) the active publisher. Trivially True when
+        leasing is off."""
+        if self._lease_ttl <= 0:
+            return True
+        with self._lock:
+            if not self._standby:
+                return True
+        try:
+            epoch = self._store.acquire_lease(self._holder,
+                                              self._lease_ttl)
+        except Exception as exc:
+            Log.warning("fleet: lease acquisition failed: %s: %s",
+                        type(exc).__name__, exc)
+            return False
+        if epoch is None:
+            return False
+        self._store.set_fence(self._holder, int(epoch))
+        if self._replay_on_acquire:
+            # from-the-log-alone rebuild: nothing this process buffered
+            # while standby (there should be nothing) survives
+            self.buffer.reset()
+            with self._lock:
+                self._wins = 0
+                self._consumed_rows = 0
+                self._replayed_rows = 0
+            self._replay()
+        with self._lock:
+            self._standby = False
+            self._lease_epoch = int(epoch)
+            self._last_renew_t = obs.monotonic()
+        telemetry.count("fleet/lease_takeovers")
+        Log.info("fleet: %s is now the ACTIVE trainer (lease epoch %d)",
+                 self._holder, epoch)
+        return True
+
+    def wait_for_lease(self, timeout_s: float) -> bool:
+        """Block until this trainer holds the lease, up to
+        ``timeout_s``. With the worker running the worker's own tick
+        does the acquiring; without one (``start=False``) this polls
+        :meth:`try_acquire` directly."""
+        deadline = obs.monotonic() + float(timeout_s)
+        while True:
+            with self._lock:
+                if not self._standby:
+                    return True
+            if self._thread is None and self.try_acquire():
+                return True
+            remaining = deadline - obs.monotonic()
+            if remaining <= 0:
+                return False
+            time.sleep(min(0.05, remaining))
+
+    def _lease_tick(self) -> bool:
+        """Worker-side lease duty: acquire when standby, heartbeat every
+        ttl/3 when active, demote the moment a renewal fails (the fence
+        epoch then blocks any publish this process still attempts).
+        Returns True when active."""
+        with self._lock:
+            standby = self._standby
+            epoch = self._lease_epoch
+            last_renew = self._last_renew_t
+        if standby:
+            return self.try_acquire()
+        if obs.monotonic() - last_renew < self._lease_ttl / 3.0:
+            return True
+        renewed = False
+        try:
+            renewed = self._store.renew_lease(self._holder, epoch,
+                                              self._lease_ttl)
+        except Exception as exc:
+            Log.warning("fleet: lease renewal errored: %s: %s",
+                        type(exc).__name__, exc)
+        if renewed:
+            with self._lock:
+                self._last_renew_t = obs.monotonic()
+            return True
+        with self._lock:
+            self._standby = True
+            self._lease_epoch = 0
+            self._lease_lost += 1
+        telemetry.count("fleet/lease_lost")
+        Log.warning("fleet: %s lost the trainer lease (epoch %d) — "
+                    "demoting to standby", self._holder, epoch)
+        return False
+
     # ---------------------------------------------------------------- cycle
     def run_once(self) -> str:
         """One synchronous train cycle: drain the buffer, build a
         candidate, shadow-score it, promote or reject. Returns
         ``"promoted"``, ``"rejected"``, ``"deferred"`` (shadow win
         banked toward ``promote_patience``, no swap yet) or
-        ``"skipped"`` (not enough data). Tests call this directly with
-        ``start=False``."""
+        ``"skipped"`` (not enough data), or ``"standby"`` (this trainer
+        does not hold the lease — only the active holder trains). Tests
+        call this directly with ``start=False``."""
         with self._lock:
+            if self._standby:
+                return "standby"
             self._last_train_t = obs.monotonic()
         data = self.buffer.take_training()
         if data is None or len(data[1]) < self._min_rows:
@@ -512,12 +687,14 @@ class OnlineTrainer:
                     # the streak reaches promote_patience
                     telemetry.count("online/deferrals")
                     self._record_gate("deferred", wins, consumed, losses)
+                    self._maybe_compact(wins, consumed)
                     self._finish("deferred", losses)
                     return "deferred"
                 with self._lock:
                     self._wins = 0
                 self._promote(candidate, builder.serialize(candidate), src)
                 self._record_gate("promoted", 0, consumed, losses)
+                self._maybe_compact(0, consumed)
                 self._finish("promoted", losses)
                 return "promoted"
             telemetry.count("online/rejections")
@@ -525,6 +702,7 @@ class OnlineTrainer:
                 self._rejections += 1
                 self._wins = 0   # a loss breaks the streak
             self._record_gate("rejected", 0, consumed, losses)
+            self._maybe_compact(0, consumed)
             self._finish("rejected", losses)
             return "rejected"
 
@@ -538,6 +716,27 @@ class OnlineTrainer:
             # durability is best-effort on a full/broken disk; the live
             # promotion decision already happened
             Log.warning("fleet: gate append failed: %s: %s",
+                        type(exc).__name__, exc)
+
+    def _maybe_compact(self, wins: int, consumed: int) -> None:
+        """Retention: once the event log outgrows ``compact_bytes``,
+        snapshot (the gate verdict just recorded made watermark+streak
+        durable) and truncate. ``keep_rows`` is the shadow window's
+        capacity — the retained ingest suffix provably rebuilds both
+        windows bit-identically."""
+        if (self._store is None or self._compact_bytes <= 0
+                or not hasattr(self._store, "compact")):
+            return
+        try:
+            if self._store.log_bytes() <= self._compact_bytes:
+                return
+            self._store.compact(watermark=consumed, wins=wins,
+                                keep_rows=self.buffer.shadow_capacity,
+                                keep_artifacts=self._keep_artifacts)
+        except Exception as exc:
+            # retention is best-effort; an uncompacted log only costs
+            # disk, never correctness
+            Log.warning("fleet: compaction failed: %s: %s",
                         type(exc).__name__, exc)
 
     # ------------------------------------------------------------ promotion
@@ -678,6 +877,12 @@ class OnlineTrainer:
                 "watch_armed": self._watch is not None,
                 "watch_rows": self._watch["rows"]
                 if self._watch is not None else 0,
+                "role": ("standby" if self._standby else "active")
+                if self._lease_ttl > 0 else "solo",
+                "lease_epoch": self._lease_epoch,
+                "lease_holder": self._holder
+                if self._lease_ttl > 0 else None,
+                "lease_lost": self._lease_lost,
             }
         if self._store is not None:
             st["store"] = self._store.state()
@@ -689,13 +894,31 @@ class OnlineTrainer:
         return st
 
     # -------------------------------------------------------------- shutdown
-    def close(self, timeout: Optional[float] = None) -> None:
-        """Stop the worker (the in-flight cycle finishes). Idempotent."""
+    def close(self, timeout: Optional[float] = None, *,
+              release_lease: bool = True) -> None:
+        """Stop the worker (the in-flight cycle finishes). Idempotent.
+
+        ``release_lease=False`` leaves the lease to expire on its own —
+        the failover bench uses it to simulate a crash (the standby must
+        wait out the ttl) and the fence stays armed so this instance's
+        late publishes still raise StaleLeaseError like a real zombie's."""
         with self._lock:
             self._stopped = True
             self._lock.notify_all()
         if self._thread is not None:
             self._thread.join(timeout)
+        if self._lease_ttl > 0 and self._store is not None \
+                and release_lease:
+            with self._lock:
+                epoch = self._lease_epoch
+                active = not self._standby
+            if active:
+                try:
+                    self._store.release_lease(self._holder, epoch)
+                    self._store.clear_fence()
+                except Exception as exc:
+                    Log.warning("fleet: lease release failed: %s: %s",
+                                type(exc).__name__, exc)
 
     def __enter__(self) -> "OnlineTrainer":
         return self

@@ -14,6 +14,16 @@
     GET  /models               registered model ids
     GET  /telemetry            full obs.Telemetry snapshot
     GET  /metrics              Prometheus text exposition format
+    GET  /fleet/latest         newest fleet publish event (trainer mode)
+    GET  /fleet/publishes      all valid publish events oldest-first
+    GET  /fleet/artifact/<v>   raw whole-model artifact bytes
+
+The three /fleet routes exist when the CLI attaches a local
+``FleetStore`` (``server.fleet_store``): they are the network transport
+remote replicas (:class:`~lightgbm_tpu.fleet.transport.RemoteStore`)
+converge through, so a replica no longer needs the trainer's
+filesystem. They carry the ``transport/serve`` chaos point (slow/torn/
+dropped responses for the failover tests).
 
 Multi-tenant: the server fronts a
 :class:`~lightgbm_tpu.online.registry.ModelRegistry`; the single-model
@@ -101,6 +111,11 @@ class PredictServer:
         # fleet replica mode: the CLI attaches the ReplicaWatcher here so
         # /healthz reports applied version/swaps and close() stops it
         self.fleet_watcher = None
+        # fleet trainer mode: a local FleetStore attached here turns on
+        # the /fleet/* transport routes + the /healthz store section
+        self.fleet_store = None
+        # remote-replica mode: the RemoteStore, for /healthz retry stats
+        self.fleet_transport = None
         self._started_at = obs.monotonic()
         # guards the draining flag: flipped by begin_shutdown (signal
         # helper thread) and read on every handler thread
@@ -120,6 +135,13 @@ class PredictServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _raw(self, code: int, body: bytes, ctype: str) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_GET(self):
                 if self.path == "/healthz":
                     self._json(200, server.healthz())
@@ -128,13 +150,63 @@ class PredictServer:
                 elif self.path == "/telemetry":
                     self._json(200, telemetry.snapshot())
                 elif self.path == "/metrics":
-                    body = obs.prometheus_text().encode("utf-8")
-                    self.send_response(200)
-                    self.send_header("Content-Type",
-                                     "text/plain; version=0.0.4")
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
+                    self._raw(200, obs.prometheus_text().encode("utf-8"),
+                              "text/plain; version=0.0.4")
+                elif self.path.startswith("/fleet/"):
+                    self._fleet()
+                else:
+                    self._json(404, {"error": "unknown path %s" % self.path})
+
+            def _fleet(self) -> None:
+                """The replica-facing transport routes, serving the
+                attached local store's publish feed + artifacts. A torn
+                chaos action truncates the response body (Content-Length
+                included, so the client's checksum — not a short-read
+                error — must catch it); a raise action answers 500."""
+                store = server.fleet_store
+                if store is None:
+                    self._json(404, {"error": "no fleet store attached"})
+                    return
+                from ..fleet import chaos
+                try:
+                    act = chaos.hit("transport/serve")
+                except Exception as exc:
+                    self._json(500, {"error": "%s: %s"
+                                     % (type(exc).__name__, exc)})
+                    return
+                torn = float(act[1]) if act is not None \
+                    and act[0] == "torn" else None
+
+                def send(body: bytes, ctype: str) -> None:
+                    if torn is not None:
+                        body = body[:int(len(body) * torn)]
+                    self._raw(200, body, ctype)
+
+                seg = [s for s in self.path.split("/") if s]
+                if seg == ["fleet", "latest"]:
+                    latest = store.latest_publish()
+                    if latest is None:
+                        self._json(404, {"error": "nothing published yet"})
+                        return
+                    send(json.dumps(latest).encode("utf-8"),
+                         "application/json")
+                elif seg == ["fleet", "publishes"]:
+                    send(json.dumps({"publishes": store.publishes()})
+                         .encode("utf-8"), "application/json")
+                elif seg[:2] == ["fleet", "artifact"] and len(seg) == 3:
+                    try:
+                        version = int(seg[2])
+                    except ValueError:
+                        self._json(404, {"error": "bad version %r" % seg[2]})
+                        return
+                    try:
+                        with open(store.artifact_path(version), "rb") as f:
+                            data = f.read()
+                    except OSError:
+                        self._json(404, {"error": "no artifact v%d"
+                                         % version})
+                        return
+                    send(data, "text/plain; charset=utf-8")
                 else:
                     self._json(404, {"error": "unknown path %s" % self.path})
 
@@ -275,6 +347,12 @@ class PredictServer:
             doc["promotions"] = promotions
         if self.fleet_watcher is not None:
             doc["fleet"] = self.fleet_watcher.state()
+        if self.fleet_store is not None:
+            # lease holder/epoch/expiry, log size, last compaction
+            doc["fleet_store"] = self.fleet_store.state()
+        if self.fleet_transport is not None:
+            # remote replica: request/retry/checksum-failure counts
+            doc["fleet_transport"] = self.fleet_transport.state()
         try:
             from .. import obs_device
             # compact device-cost view: HBM watermark + capture totals

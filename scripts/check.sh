@@ -7,7 +7,8 @@
 #                       run under the pallas interpreter)
 #   check.sh --fast     lint only files changed vs git + lint tests
 #   check.sh --fleet    lint + lint tests + the fleet/online/serve fast
-#                       subset (the durability/fairness/rollback layer)
+#                       subset (durability/fairness/rollback plus the
+#                       failover/compaction/transport hardening tests)
 #   check.sh --slo      everything above, plus the closed-loop serving
 #                       SLO bench gated against SLO_BASELINE.json
 #   check.sh --ledger   everything above, plus the run-ledger regression
@@ -46,7 +47,8 @@ fi
 if [ "$RUN_FLEET" = 1 ]; then
     echo "== fleet/online/serve fast tests =="
     JAX_PLATFORMS=cpu python -m pytest -q -m 'not slow' \
-        tests/test_fleet.py tests/test_online.py tests/test_serve.py
+        tests/test_fleet.py tests/test_failover.py \
+        tests/test_online.py tests/test_serve.py
 fi
 
 if [ "$RUN_SLO" = 1 ]; then

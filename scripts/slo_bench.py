@@ -4,6 +4,7 @@ Usage:
     python scripts/slo_bench.py --quick                # CPU-sized run
     python scripts/slo_bench.py --quick --online       # + live refit loop
     python scripts/slo_bench.py --quick --fleet        # trainer + 2 replicas
+    python scripts/slo_bench.py --quick --failover     # lease-crash drill
     python scripts/slo_bench.py --quick --noisy-tenant # fairness demo
     python scripts/slo_bench.py --baseline SLO_BASELINE.json
     python scripts/slo_bench.py --against SLO_BASELINE.json
@@ -14,6 +15,14 @@ publishes promotions through a durable FleetStore while TWO serving
 replicas (own boosters, own HTTP servers) watch it and hot-swap; the
 gate checks both replicas converge to the published version with exactly
 one whole-model version bump per applied publish.
+
+``--failover`` is the lease-crash drill under the same closed-loop load:
+an active trainer (short lease ttl) and a warm standby share one store;
+after the first promotion the active is killed WITHOUT releasing its
+lease. Gates: the standby goes active within the ttl window, the dead
+holder's late publish raises StaleLeaseError, a post-takeover promotion
+lands, both replicas re-converge, version tokens stay unique, and every
+applied publish is exactly one whole-model version bump.
 
 ``--noisy-tenant`` measures per-tenant fairness: a quota-respecting
 tenant's client-side p99 is taken solo, then again while a flooding
@@ -226,6 +235,199 @@ def _run_fleet(args) -> int:
     return 0 if result["pass"] else 1
 
 
+def _run_failover(args) -> int:
+    """Failover e2e under load: active trainer A (short lease) + standby
+    B + two serving replicas; A crashes without releasing its lease, B
+    must take over inside the ttl window, keep publishing, and both
+    replicas must converge — while A's zombie publish stays fenced."""
+    import tempfile
+
+    from lightgbm_tpu import obs
+    from lightgbm_tpu.basic import LightGBMError
+    from lightgbm_tpu.fleet import FleetStore, ReplicaWatcher, \
+        bootstrap_model
+    from lightgbm_tpu.fleet.store import StaleLeaseError
+    from lightgbm_tpu.online import OnlineTrainer
+
+    from lightgbm_tpu.serve import PredictServer
+
+    preset = _preset(args)
+    clients = args.clients or preset["clients"]
+    total = args.requests or preset["requests"]
+    rows = args.rows_per_request
+    # the ttl must outlast a full train cycle under load, or the active
+    # trainer's heartbeat (every ttl/3, between cycles) misses and the
+    # standby steals the lease before the scripted crash
+    ttl = 5.0
+    bst, rng, w = _train_seed(preset)
+
+    tmp = tempfile.mkdtemp(prefix="lgbtpu_failover_bench_")
+    store_a = FleetStore(tmp, "default")
+    store_a.publish(bst.model_to_string(), event="boot")
+    online_kw = dict(trigger_rows=max(256, rows * 8), min_rows=128,
+                     shadow_rows=1024, lease_ttl_s=ttl)
+
+    trainer_a = OnlineTrainer(bst, store=store_a, holder_id="trainer-a",
+                              **online_kw)
+    if not trainer_a.wait_for_lease(30):
+        print(json.dumps({"bench": "slo_failover", "pass": False,
+                          "gate_failures": ["trainer-a never went active"]}))
+        return 1
+    # the standby runs as a second process would: its own store handle
+    # over the same dir, its own booster bootstrapped from the publishes
+    store_b = FleetStore(tmp, "default")
+    bst_b, _ = bootstrap_model(store_b)
+    trainer_b = OnlineTrainer(bst_b, store=store_b, holder_id="trainer-b",
+                              **online_kw)
+
+    replicas = []
+    for i in range(2):
+        rb, applied = bootstrap_model(store_a)
+        server = PredictServer(rb, port=0, buckets=(64, 256), warmup=True,
+                               max_wait_ms=2.0)
+        server.fleet_watcher = ReplicaWatcher(
+            rb, store_a, poll_interval_s=0.1, applied_version=applied)
+        th = threading.Thread(target=server.serve_forever,
+                              name="slo-failover-replica%d" % i,
+                              daemon=True)
+        th.start()
+        host, port = server.address
+        replicas.append({"server": server, "thread": th, "booster": rb,
+                         "base": "http://%s:%d" % (host, port),
+                         "v0": rb.inner.model_version})
+
+    stop_ingest = threading.Event()
+    target = {"trainer": trainer_a}
+
+    def ingest_loop():
+        while not stop_ingest.is_set():
+            Xi = rng.randn(64, preset["features"])
+            yi = (Xi @ w > 0).astype("float64")
+            try:
+                target["trainer"].ingest(Xi, yi)
+            except Exception:  # noqa: BLE001 - keep feeding
+                pass
+            time.sleep(0.02)
+
+    ingester = threading.Thread(target=ingest_loop,
+                                name="slo-failover-ingest", daemon=True)
+    ingester.start()
+
+    fails, sheds = [], []
+    threads = [threading.Thread(
+        target=_client, name="slo-failover-c%d" % i,
+        args=(replicas[i % 2]["base"], total // clients, rows,
+              json.dumps({"rows": rng.randn(
+                  rows, preset["features"]).tolist()}).encode(),
+              fails, sheds))
+        for i in range(clients)]
+    for t in threads:
+        t.start()
+
+    gate_msgs = []
+    grace = 30 if args.quick else 60
+
+    # phase 1: A must land at least one promotion before we kill it
+    deadline = obs.monotonic() + grace
+    while obs.monotonic() < deadline \
+            and trainer_a.state()["promotions"] < 1:
+        time.sleep(0.1)
+    promos_a = trainer_a.state()["promotions"]
+    if promos_a < 1:
+        gate_msgs.append("trainer-a landed no promotion in the grace "
+                         "window")
+
+    # phase 2: crash A (lease left to expire, fence left armed) and time
+    # the standby's takeover
+    trainer_a.close(timeout=30, release_lease=False)
+    t_crash = obs.monotonic()
+    target["trainer"] = trainer_b
+    takeover_s = None
+    deadline = t_crash + ttl * 10 + grace
+    while obs.monotonic() < deadline:
+        if trainer_b.state()["role"] == "active":
+            takeover_s = obs.monotonic() - t_crash
+            break
+        time.sleep(0.05)
+    if takeover_s is None:
+        gate_msgs.append("standby never took over (waited %.0fs)"
+                         % (deadline - t_crash))
+
+    # phase 3: the dead holder's late publish must be fenced off
+    zombie_blocked = False
+    if takeover_s is not None:
+        try:
+            store_a.publish(bst.model_to_string(), event="promotion")
+        except (StaleLeaseError, LightGBMError):
+            zombie_blocked = True
+        if not zombie_blocked:
+            gate_msgs.append("zombie publish from the crashed trainer "
+                             "was NOT fenced off")
+
+    # phase 4: B keeps the pipeline alive — a post-takeover promotion
+    # lands and both replicas converge on the newest publish
+    converged = False
+    deadline = obs.monotonic() + grace
+    while obs.monotonic() < deadline:
+        published = store_a.state()["last_published_version"]
+        if trainer_b.state()["promotions"] >= 1 and all(
+                r["server"].fleet_watcher.applied_version == published
+                for r in replicas):
+            converged = True
+            break
+        time.sleep(0.1)
+    if trainer_b.state()["promotions"] < 1:
+        gate_msgs.append("no post-takeover promotion landed")
+    published = store_a.state()["last_published_version"]
+    if not converged:
+        gate_msgs.append("replicas did not converge to v%d after "
+                         "failover" % published)
+
+    for t in threads:
+        t.join()
+    stop_ingest.set()
+    ingester.join(timeout=30)
+    trainer_b.close(timeout=30)
+
+    versions = [p["version"] for p in store_b.publishes()]
+    if len(set(versions)) != len(versions):
+        gate_msgs.append("version tokens were reused: %r" % versions)
+
+    rep_docs = []
+    for r in replicas:
+        st = r["server"].fleet_watcher.state()
+        bumps = r["booster"].inner.model_version - r["v0"]
+        if bumps != st["swaps"]:
+            gate_msgs.append("version bumps != applied swaps (torn swap?)")
+        rep_docs.append({"applied_version": st["applied_version"],
+                         "swaps": st["swaps"], "version_bumps": bumps})
+        r["server"].shutdown()
+        r["thread"].join(timeout=30)
+        r["server"].close()
+    if fails:
+        gate_msgs.append("%d request failures" % len(fails))
+
+    result = {
+        "bench": "slo_failover",
+        "quick": bool(args.quick),
+        "lease_ttl_s": ttl,
+        "takeover_s": None if takeover_s is None else round(takeover_s, 3),
+        "promotions_before_crash": promos_a,
+        "promotions_after_takeover": trainer_b.state()["promotions"],
+        "zombie_publish_blocked": zombie_blocked,
+        "published_version": published,
+        "publish_versions": versions,
+        "replicas": rep_docs,
+        "store_dir": tmp,
+        "errors": fails[:5],
+        "pass": not gate_msgs,
+    }
+    if gate_msgs:
+        result["gate_failures"] = gate_msgs
+    print(json.dumps(result))
+    return 0 if result["pass"] else 1
+
+
 def _run_noisy_tenant(args) -> int:
     """Fairness demo/gate: a flooding tenant saturates its quota while a
     quota-respecting tenant keeps its solo latency profile."""
@@ -327,6 +529,11 @@ def main(argv=None) -> int:
     ap.add_argument("--fleet", action="store_true",
                     help="fleet e2e: trainer publishing through a durable "
                          "store, two hot-swapping serving replicas")
+    ap.add_argument("--failover", action="store_true",
+                    help="failover e2e: active trainer crashes without "
+                         "releasing its lease; the standby must take "
+                         "over, stay fenced against zombie publishes, "
+                         "and re-converge both replicas")
     ap.add_argument("--noisy-tenant", action="store_true",
                     help="per-tenant fairness gate: flooding tenant vs "
                          "quota-respecting tenant")
@@ -348,6 +555,8 @@ def main(argv=None) -> int:
 
     if args.fleet:
         return _run_fleet(args)
+    if args.failover:
+        return _run_failover(args)
     if args.noisy_tenant:
         return _run_noisy_tenant(args)
 

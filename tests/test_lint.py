@@ -360,16 +360,20 @@ def test_repo_is_lint_clean():
 
 
 def test_full_lint_is_fast():
-    # best-of-two: a single wall-clock sample is at the mercy of whatever
-    # else the machine is doing; the budget is about the linter, not the box
+    # best-of-three: a single wall-clock sample is at the mercy of whatever
+    # else the machine is doing; the budget is about the linter, not the box.
+    # 8s is ~2.5x the unloaded time on a slow CI box — loose enough that a
+    # box running at 60% speed (observed across otherwise identical full-
+    # suite runs) doesn't trip it, tight enough to catch a superlinear
+    # regression in the graph engine.
     best = float("inf")
-    for _ in range(2):
+    for _ in range(3):
         t0 = time.perf_counter()
         lint.run(REPO)
         best = min(best, time.perf_counter() - t0)
-        if best < 5.0:
+        if best < 8.0:
             break
-    assert best < 5.0
+    assert best < 8.0
 
 
 def test_cli_json_exit_zero():
