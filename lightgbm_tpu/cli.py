@@ -260,7 +260,11 @@ class Application:
             store = None
             if cfg.fleet_dir:
                 from .fleet import FleetStore, bootstrap_model
-                store = FleetStore(cfg.fleet_dir, mid)
+                # a replica over a shared filesystem is a pure reader:
+                # it must not run the open-time torn-tail repair or
+                # orphan reaping against a live trainer's files
+                store = FleetStore(cfg.fleet_dir, mid,
+                                   read_only=fleet_replica)
                 booster, applied = bootstrap_model(store)
             elif cfg.fleet_url:
                 from .fleet import RemoteStore, bootstrap_model

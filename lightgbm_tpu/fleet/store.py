@@ -39,9 +39,23 @@ bumps ``epoch``, the fencing token. A trainer arms its store with
 :meth:`set_fence`; :meth:`publish` then re-reads the lease and refuses
 (:class:`StaleLeaseError`) unless holder+epoch still match, so a paused
 ("zombie") trainer that lost its lease cannot publish over its
-successor. Readers additionally reject any publish event whose epoch is
-below an epoch already seen earlier in the log (a zombie write that
-raced the fence check on another host).
+successor. Readers additionally reject any publish event whose non-zero
+epoch is below an epoch already seen earlier in the log (a zombie write
+that raced the fence check on another host). Epoch 0 marks an UNFENCED
+publisher (leasing disabled) and is exempt from that rejection —
+turning ``fleet_lease_ttl_s`` off after a fenced tenure must not
+silently drop every later publish (it is warned about and counted
+instead).
+
+**Cross-process writes.** The failover feature makes the log genuinely
+multi-writer: a standby trainer persists every ingest chunk to the same
+``events.jsonl`` the active holder appends to. Single appends interleave
+safely (one write call per line), but compaction's snapshot→rewrite and
+the open-time torn-tail repair do not — so every append, the repair and
+the whole compaction critical section hold a cross-process writer mutex
+(``flock`` on the ``events.jsonl.lock`` sidecar, released by the kernel
+if the holder dies). Replica-role opens pass ``read_only=True`` and
+never mutate the log at all.
 """
 from __future__ import annotations
 
@@ -50,10 +64,17 @@ import json
 import os
 import threading
 import time
+from contextlib import contextmanager
 from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
+try:
+    import fcntl   # POSIX: cross-process writer mutex via flock
+except ImportError:   # pragma: no cover — non-POSIX fallback below
+    fcntl = None
+
+from .. import obs
 from ..obs import telemetry
 from ..obs_ledger import append_jsonl, read_jsonl
 from ..utils.log import LightGBMError, Log
@@ -113,10 +134,15 @@ class FleetStore:
     event (a publisher died between ``os.replace`` and its event append)
     are reaped — but only when older than this grace, so opening a store
     never races another process's in-flight publish.
+
+    ``read_only``: a replica-role open over a shared filesystem. Skips
+    the destructive open-time maintenance (torn-tail repair, orphan
+    reaping) a pure reader must never run against a live writer's files.
     """
 
     def __init__(self, root: str, model_id: str = "default", *,
-                 orphan_grace_s: float = 60.0) -> None:
+                 orphan_grace_s: float = 60.0,
+                 read_only: bool = False) -> None:
         model_id = str(model_id)
         if not model_id or "/" in model_id or model_id.startswith("."):
             raise LightGBMError("fleet model_id must be a plain name, "
@@ -140,10 +166,18 @@ class FleetStore:
         self._orphans_reaped = 0
         self._stale_seen: set = set()
         self._corrupt_seen: set = set()
-        self._repair_torn_tail()
+        self._warned_unfenced = False
+        self._read_only = bool(read_only)
+        if not self._read_only:
+            # under the writer mutex: a tail that is torn while no other
+            # writer can be mid-append is genuinely dead, never a
+            # partially-visible in-flight line of a live process
+            with self._writer_mutex():
+                self._repair_torn_tail()
         valid, max_version, _max_epoch, _stale = self._scan_publishes()
         self._last_version = max_version
-        self._reap_orphans(max_version, float(orphan_grace_s))
+        if not self._read_only:
+            self._reap_orphans(max_version, float(orphan_grace_s))
 
     # ---------------------------------------------------------------- identity
     @property
@@ -202,12 +236,56 @@ class FleetStore:
         Log.warning("fleet: truncated %d-byte torn tail line in %s",
                     size - keep, self._events_path)
 
+    @contextmanager
+    def _writer_mutex(self):
+        """Cross-process mutex over every ``events.jsonl`` mutation.
+
+        The in-process RLock cannot serialize a standby trainer's ingest
+        appends (another process, its own store instance) against this
+        process's compaction rewrite — a line appended between the scan
+        and the ``os.replace`` would die with the old inode. So every
+        append, the open-time torn-tail repair and the whole compaction
+        critical section hold an exclusive ``flock`` on the
+        ``events.jsonl.lock`` sidecar: it blocks until free and the
+        kernel releases it when the holder dies, so there is no stale
+        state to break. Non-POSIX fallback: the lease-style O_EXCL
+        guard, best-effort (proceeds with a warning if never acquired).
+        """
+        path = self._events_path + ".lock"
+        if fcntl is not None:
+            fd = os.open(path, os.O_WRONLY | os.O_CREAT, 0o644)
+            try:
+                fcntl.flock(fd, fcntl.LOCK_EX)
+                yield
+            finally:
+                os.close(fd)   # closing the fd drops the flock
+            return
+        held = self._guard_wait(path,   # pragma: no cover — non-POSIX
+                                timeout_s=2.0 * _GUARD_STALE_S)
+        if not held:   # pragma: no cover
+            Log.warning("fleet: events writer guard %s stuck busy; "
+                        "proceeding unserialized", path)
+            yield
+            return
+        try:   # pragma: no cover
+            yield
+        finally:
+            self._guard_release(path)
+
+    def _assert_writable(self) -> None:
+        if self._read_only:
+            raise LightGBMError(
+                "fleet store %s opened read_only (replica role) cannot "
+                "append, publish or compact" % self._dir)
+
     def _append(self, entry: Dict[str, Any]) -> None:
         """All event appends funnel here: serialized against compaction's
-        atomic rewrite, and carrying the ``store/append`` chaos point (a
-        torn action writes a prefix of the line and raises — the
+        atomic rewrite (in-process by the store lock, cross-process by
+        the events writer mutex), and carrying the ``store/append`` chaos
+        point (a torn action writes a prefix of the line and raises — the
         simulated crash the corrupt-line skip on replay recovers from)."""
-        with self._lock:
+        self._assert_writable()
+        with self._lock, self._writer_mutex():
             act = chaos.hit("store/append")
             if act is not None and act[0] == "torn":
                 line = (json.dumps(entry, sort_keys=True)
@@ -269,12 +347,11 @@ class FleetStore:
             os.close(fd)
         os.replace(tmp, self._lease_path)
 
-    def _guard_acquire(self) -> bool:
-        """O_EXCL guard file serializing lease read-modify-write across
+    def _guard_acquire(self, path: str) -> bool:
+        """O_EXCL guard file serializing a read-modify-write across
         processes; a guard left by a crashed acquirer is broken after
         ``_GUARD_STALE_S``. Returns False when another acquirer is live
-        right now (the caller treats that as lease-unavailable)."""
-        path = self._lease_path + ".lock"
+        right now (the caller treats that as guard-unavailable)."""
         for _ in range(2):
             try:
                 fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_EXCL,
@@ -296,11 +373,24 @@ class FleetStore:
             return True
         return False
 
-    def _guard_release(self) -> None:
+    def _guard_release(self, path: str) -> None:
         try:
-            os.unlink(self._lease_path + ".lock")
+            os.unlink(path)
         except OSError:
             pass
+
+    def _guard_wait(self, path: str, timeout_s: float = 0.5) -> bool:
+        """Blocking :meth:`_guard_acquire`: the guard's critical sections
+        are a tiny json read+write, so a busy guard clears in
+        microseconds — spin briefly instead of failing a heartbeat (and
+        demoting a healthy trainer) over a concurrent standby's probe."""
+        deadline = obs.monotonic() + float(timeout_s)
+        while True:
+            if self._guard_acquire(path):
+                return True
+            if obs.monotonic() >= deadline:
+                return False
+            time.sleep(0.01)
 
     def acquire_lease(self, holder: str, ttl_s: float) -> Optional[int]:
         """Try to take the trainer lease. Returns the new fencing epoch,
@@ -312,7 +402,7 @@ class FleetStore:
         if ttl_s <= 0:
             raise LightGBMError("lease ttl_s must be > 0, got %g" % ttl_s)
         with self._lock:
-            if not self._guard_acquire():
+            if not self._guard_acquire(self._lease_path + ".lock"):
                 return None
             try:
                 cur = self._read_lease()
@@ -326,7 +416,7 @@ class FleetStore:
                     "expires_ts": now + float(ttl_s), "acquired_ts": now,
                     "pid": os.getpid()})
             finally:
-                self._guard_release()
+                self._guard_release(self._lease_path + ".lock")
         telemetry.count("fleet/lease_acquired")
         telemetry.gauge("fleet/lease_epoch", epoch)
         Log.info("fleet: %s acquired trainer lease (epoch %d, ttl %gs)",
@@ -337,29 +427,54 @@ class FleetStore:
         """Heartbeat: extend the lease iff still held by ``holder`` at
         ``epoch``. An expired-but-untaken lease renews fine (the holder
         merely heartbeat late); a lease re-acquired by anyone (epoch
-        moved on) does not — the caller must demote to standby."""
+        moved on) does not — the caller must demote to standby.
+
+        Runs inside the same O_EXCL guard as :meth:`acquire_lease`:
+        without it, an old holder's renew racing a standby's takeover
+        could read the pre-takeover lease and write it back (extended,
+        old epoch) AFTER the takeover's ``os.replace``, resurrecting the
+        dead epoch and flapping both trainers active/standby."""
+        lock = self._lease_path + ".lock"
         with self._lock:
-            cur = self._read_lease()
-            if (cur is None or cur.get("holder") != str(holder)
-                    or int(cur.get("epoch", -1)) != int(epoch)):
+            if not self._guard_wait(lock):
+                Log.warning("fleet: lease renewal for %s blocked by a "
+                            "concurrent acquirer; demoting", holder)
                 return False
-            now = time.time()  # graftlint: disable=naked-timer -- epoch timestamp, not a duration
-            cur["expires_ts"] = now + float(ttl_s)
-            self._write_lease(cur)
+            try:
+                cur = self._read_lease()
+                if (cur is None or cur.get("holder") != str(holder)
+                        or int(cur.get("epoch", -1)) != int(epoch)):
+                    return False
+                now = time.time()  # graftlint: disable=naked-timer -- epoch timestamp, not a duration
+                cur["expires_ts"] = now + float(ttl_s)
+                self._write_lease(cur)
+            finally:
+                self._guard_release(lock)
         return True
 
     def release_lease(self, holder: str, epoch: int) -> bool:
         """Clean handoff: expire the lease immediately (epoch kept, so
         the next acquirer still bumps past it). No-op unless still held
-        by ``holder`` at ``epoch``."""
+        by ``holder`` at ``epoch``. Guarded like :meth:`renew_lease` —
+        an unguarded release racing a takeover could clobber the new
+        holder's lease with an expired copy of the old one."""
+        lock = self._lease_path + ".lock"
         with self._lock:
-            cur = self._read_lease()
-            if (cur is None or cur.get("holder") != str(holder)
-                    or int(cur.get("epoch", -1)) != int(epoch)):
+            if not self._guard_wait(lock):
+                Log.warning("fleet: lease release for %s blocked by a "
+                            "concurrent acquirer; leaving it to expire",
+                            holder)
                 return False
-            cur["expires_ts"] = 0.0
-            cur["released_ts"] = time.time()  # graftlint: disable=naked-timer -- epoch timestamp, not a duration
-            self._write_lease(cur)
+            try:
+                cur = self._read_lease()
+                if (cur is None or cur.get("holder") != str(holder)
+                        or int(cur.get("epoch", -1)) != int(epoch)):
+                    return False
+                cur["expires_ts"] = 0.0
+                cur["released_ts"] = time.time()  # graftlint: disable=naked-timer -- epoch timestamp, not a duration
+                self._write_lease(cur)
+            finally:
+                self._guard_release(lock)
         return True
 
     def lease_state(self) -> Dict[str, Any]:
@@ -403,6 +518,7 @@ class FleetStore:
         if event not in PUBLISH_EVENTS:
             raise LightGBMError("publish event must be one of %s, got %r"
                                 % ("|".join(PUBLISH_EVENTS), event))
+        self._assert_writable()
         with self._lock:
             epoch = 0
             if self._fence is not None:
@@ -419,9 +535,22 @@ class FleetStore:
             # instance over the same dir) may have published since this
             # store was opened: re-read the allocation floor from the log
             # so a standby that takes over never reuses a version token
-            _valid, max_version, _maxe, _stale = self._scan_publishes()
+            _valid, max_version, max_epoch, _stale = self._scan_publishes()
             if max_version > self._last_version:
                 self._last_version = max_version
+            if epoch == 0 and max_epoch > 0:
+                # unfenced publish into a log with fenced history:
+                # leasing was on once and is off now — readers apply the
+                # publish (epoch 0 is exempt from stale rejection) but
+                # the likely misconfiguration must be loud
+                telemetry.count("fleet/unfenced_publishes")
+                if not self._warned_unfenced:
+                    self._warned_unfenced = True
+                    Log.warning(
+                        "fleet: unfenced publish (lease epoch 0) into a "
+                        "store whose log has fenced publishes up to "
+                        "epoch %d — was fleet_lease_ttl_s disabled on "
+                        "purpose?", max_epoch)
             version = self._last_version + 1
             name = _ARTIFACT_FMT % version
             final = os.path.join(self._models_dir, name)
@@ -465,11 +594,15 @@ class FleetStore:
         max version over ALL publishes incl. stale + compact floor,
         max epoch, stale publishes).
 
-        A publish is STALE when its lease epoch is below an epoch already
-        seen earlier in the log — a zombie trainer's write that raced the
-        fence. Stale versions still raise the allocation floor (tokens
-        are never reused) but are never applied. Compact records carry
-        the floors for everything they truncated."""
+        A publish is STALE when its NON-ZERO lease epoch is below an
+        epoch already seen earlier in the log — a zombie trainer's write
+        that raced the fence. Epoch 0 marks an unfenced publisher
+        (leasing disabled) and is exempt: an operator turning
+        ``fleet_lease_ttl_s`` off after a fenced tenure must not have
+        every later publish silently dropped forever. Stale versions
+        still raise the allocation floor (tokens are never reused) but
+        are never applied. Compact records carry the floors for
+        everything they truncated."""
         valid: List[Dict[str, Any]] = []
         stale: List[Dict[str, Any]] = []
         max_version = 0
@@ -488,7 +621,7 @@ class FleetStore:
                 continue
             max_version = max(max_version, v)
             epoch = int(e.get("lease_epoch", 0))
-            if epoch < max_epoch:
+            if 0 < epoch < max_epoch:
                 stale.append(e)
                 continue
             max_epoch = max(max_epoch, epoch)
@@ -637,17 +770,26 @@ class FleetStore:
         ``keep_rows``.
 
         ``keep_artifacts`` > 0 additionally retains only that many newest
-        publish events and deletes the older artifact files; 0 keeps all.
-        Returns a summary dict. Must run in the (single) writer process —
-        in-process appends are serialized against the rewrite by the
-        store lock."""
-        with self._lock:
+        VALID publish events (stale-epoch zombie publishes never fill the
+        retention window — they are dropped and their artifacts deleted;
+        the compact record's version/epoch floors stand in for them) and
+        deletes the unretained artifact files; 0 keeps all publishes.
+        Returns a summary dict. The whole snapshot→rewrite section holds
+        the cross-process events writer mutex: a standby trainer's
+        ingest append from another process blocks until the ``os.replace``
+        lands instead of dying with the old inode (in-process appends are
+        additionally serialized by the store lock)."""
+        self._assert_writable()
+        with self._lock, self._writer_mutex():
             events = list(self.events())
             row_base = 0
             last_version = 0
             lease_epoch = 0
             ingests: List[Tuple[int, int, Dict[str, Any]]] = []
-            publishes: List[Dict[str, Any]] = []
+            # (event, is_stale) — staleness mirrors _scan_publishes:
+            # a non-zero epoch below the running max (which includes
+            # prior compact records' floors) is a zombie's write
+            publishes: List[Tuple[Dict[str, Any], bool]] = []
             seen = None
             for e in events:
                 kind = e.get("kind")
@@ -665,11 +807,13 @@ class FleetStore:
                     ingests.append((lo, seen, e))
                 elif kind == "publish":
                     v = e.get("version")
+                    is_stale = False
                     if isinstance(v, int):
                         last_version = max(last_version, v)
-                        lease_epoch = max(lease_epoch,
-                                          int(e.get("lease_epoch", 0)))
-                    publishes.append(e)
+                        epoch = int(e.get("lease_epoch", 0))
+                        is_stale = 0 < epoch < lease_epoch
+                        lease_epoch = max(lease_epoch, epoch)
+                    publishes.append((e, is_stale))
             total_rows = ingests[-1][1] if ingests else row_base
             # retained = mandatory unconsumed suffix + shadow-cover suffix
             keep_from = len(ingests)
@@ -684,13 +828,15 @@ class FleetStore:
                     break
             kept_ingests = ingests[keep_from:]
             new_row_base = kept_ingests[0][0] if kept_ingests else total_rows
-            kept_publishes = publishes
+            kept_publishes = [e for e, _ in publishes]
             dropped_artifacts = 0
             if int(keep_artifacts) > 0:
-                kept_publishes = publishes[-int(keep_artifacts):]
+                valid_pubs = [e for e, is_stale in publishes
+                              if not is_stale]
+                kept_publishes = valid_pubs[-int(keep_artifacts):]
                 kept_versions = {int(e["version"]) for e in kept_publishes
                                  if isinstance(e.get("version"), int)}
-                for e in publishes:
+                for e, _ in publishes:
                     v = e.get("version")
                     if isinstance(v, int) and v not in kept_versions:
                         try:
@@ -750,6 +896,7 @@ class FleetStore:
             return {
                 "root": self._root,
                 "model_id": self._model_id,
+                "read_only": self._read_only,
                 "last_published_version": self._last_version,
                 "publishes_this_process": self._publishes,
                 "ingest_rows_persisted": self._ingest_rows,

@@ -125,6 +125,51 @@ def test_lease_expiry_allows_takeover(tmp_path):
     assert store.renew_lease("a", 1, 30.0) is False
 
 
+def test_lease_renew_release_guarded_against_concurrent_acquirer(tmp_path):
+    """renew/release run the same cross-process O_EXCL guard as
+    acquire_lease: an old holder's read-modify-write must never land
+    around a standby's takeover and resurrect the dead epoch. While a
+    live acquirer holds the guard, renew/release back off (and the
+    caller demotes) instead of writing blind."""
+    store = FleetStore(str(tmp_path), "m")
+    assert store.acquire_lease("a", ttl_s=30.0) == 1
+    guard = os.path.join(str(tmp_path), "m", "lease.json.lock")
+    with open(guard, "w") as f:
+        f.write("424242")   # a live (fresh-mtime) concurrent acquirer
+    assert store.renew_lease("a", 1, 30.0) is False
+    assert store.release_lease("a", 1) is False
+    # the lease file itself was never touched through the held guard
+    assert store.lease_state()["holder"] == "a"
+    os.unlink(guard)
+    assert store.renew_lease("a", 1, 30.0) is True
+    assert store.release_lease("a", 1) is True
+    assert store.lease_state()["held"] is False
+
+
+def test_unfenced_publish_applied_after_fenced_history(tmp_path):
+    """Leasing switched OFF after a fenced tenure: epoch-0 publishes are
+    exempt from stale-epoch rejection (the fleet must keep converging),
+    but each one is counted and the first is warned about."""
+    store = FleetStore(str(tmp_path), "m")
+    assert store.acquire_lease("a", ttl_s=30.0) == 1
+    store.set_fence("a", 1)
+    assert store.publish("model-one") == 1
+    assert store.release_lease("a", 1) is True
+    # operator restarts the trainer with fleet_lease_ttl_s=0: no fence
+    store.clear_fence()
+    unfenced0 = telemetry.counter("fleet/unfenced_publishes")
+    rejected0 = telemetry.counter("fleet/stale_publishes_rejected")
+    assert store.publish("model-two") == 2
+    assert telemetry.counter("fleet/unfenced_publishes") == unfenced0 + 1
+    # replicas and cold boots both apply the unfenced publish
+    assert store.latest_publish()["version"] == 2
+    fresh = FleetStore(str(tmp_path), "m", orphan_grace_s=3600.0)
+    assert [e["version"] for e in fresh.publishes()] == [1, 2]
+    event, model = fresh.latest_valid_publish(0)
+    assert event["version"] == 2 and model == "model-two"
+    assert telemetry.counter("fleet/stale_publishes_rejected") == rejected0
+
+
 def test_publish_fencing_blocks_zombie(tmp_path):
     store_a = FleetStore(str(tmp_path), "m")
     assert store_a.acquire_lease("a", ttl_s=0.15) == 1
@@ -293,6 +338,41 @@ def test_torn_append_repaired_on_open(tmp_path):
                      ("gate", "promoted")]
 
 
+def test_read_only_open_skips_destructive_maintenance(tmp_path):
+    """A replica-role open over a shared filesystem is a pure reader: it
+    must not truncate a tail it may be seeing mid-write, must not reap
+    artifacts, and refuses to write outright."""
+    store = FleetStore(str(tmp_path), "m")
+    store.append_gate("rejected", 0, 4, None)
+    assert store.publish("model-one", event="boot") == 1
+    # a torn tail + an orphan artifact, as a reader might observe them
+    # while a live writer is mid-publish
+    with open(store.events_path, "a", encoding="utf-8") as f:
+        f.write('{"v": 1, "kind": "ga')
+    with open(store.artifact_path(9), "wb") as f:
+        f.write(b"in-flight")
+    size = store.log_bytes()
+    repaired0 = telemetry.counter("fleet/torn_tail_repaired")
+    replica = FleetStore(str(tmp_path), "m", read_only=True,
+                         orphan_grace_s=0.0)
+    assert replica.log_bytes() == size   # tail untouched
+    assert os.path.exists(store.artifact_path(9))   # orphan untouched
+    assert telemetry.counter("fleet/torn_tail_repaired") == repaired0
+    assert replica.state()["read_only"] is True
+    # reads work; every write surface is refused
+    assert replica.latest_publish()["version"] == 1
+    with pytest.raises(LightGBMError):
+        replica.append_gate("promoted", 0, 8, None)
+    with pytest.raises(LightGBMError):
+        replica.publish("model-two")
+    with pytest.raises(LightGBMError):
+        replica.compact(watermark=0, wins=0, keep_rows=10)
+    # a writer-role reopen still repairs the dead tail
+    fresh = FleetStore(str(tmp_path), "m", orphan_grace_s=3600.0)
+    assert fresh.log_bytes() < size
+    assert telemetry.counter("fleet/torn_tail_repaired") == repaired0 + 1
+
+
 # ------------------------------------------------------------ compaction
 
 def test_compaction_replay_is_bit_identical(tmp_path):
@@ -381,6 +461,74 @@ def test_trainer_compacts_and_bounds_log_and_artifacts(tmp_path):
     tr2.ingest(*_data(40, seed=99))
     assert tr2.run_once() == "promoted"
     assert tr2.state()["store"]["last_published_version"] == 7
+
+
+def test_compaction_retention_skips_stale_publishes(tmp_path):
+    """keep_artifacts must count VALID publishes only: a zombie's
+    stale-epoch events must neither fill the retention window (evicting
+    the newest good artifacts) nor survive the rewrite — the compact
+    record's version/epoch floors stand in for them."""
+    import hashlib
+    store = FleetStore(str(tmp_path), "m")
+    assert store.acquire_lease("a", ttl_s=30.0) == 1
+    store.set_fence("a", 1)
+    assert store.publish("model-one") == 1
+    assert store.release_lease("a", 1) is True
+    assert store.acquire_lease("b", ttl_s=30.0) == 2
+    store.set_fence("b", 2)
+    assert store.publish("model-two") == 2
+    assert store.publish("model-three") == 3
+    # forge a raced zombie append at the OLD epoch, newest in the log
+    data = b"zombie-model"
+    with open(store.artifact_path(4), "wb") as f:
+        f.write(data)
+    with open(store.events_path, "a", encoding="utf-8") as f:
+        f.write(json.dumps({
+            "v": 1, "kind": "publish", "ts": 0.0, "version": 4,
+            "artifact": "v000004.txt", "event": "promotion",
+            "sha256": hashlib.sha256(data).hexdigest(),
+            "bytes": len(data), "lease_epoch": 1, "meta": None}) + "\n")
+    summary = store.compact(watermark=0, wins=0, keep_rows=10**9,
+                            keep_artifacts=2)
+    # the window kept v2+v3 (newest VALID), not v3+zombie-v4
+    assert [e["version"] for e in store.publishes()] == [2, 3]
+    assert store.latest_valid_publish(0)[0]["version"] == 3
+    assert summary["dropped_artifacts"] == 2   # v1 and the zombie's v4
+    assert not os.path.exists(store.artifact_path(1))
+    assert not os.path.exists(store.artifact_path(4))
+    # the zombie's token is still burned: allocation resumes past it
+    assert store.publish("model-five") == 5
+
+
+def test_compaction_never_loses_concurrent_appends(tmp_path):
+    """The multi-writer hole the failover feature opens: a standby
+    trainer (another process — here a second store instance, which holds
+    its own flock fd) persists ingest chunks to the same events.jsonl
+    while the active trainer compacts. Every acked append must survive
+    every snapshot→rewrite, whatever the interleaving."""
+    active = FleetStore(str(tmp_path), "m")
+    standby = FleetStore(str(tmp_path), "m")
+    n_chunks, errors = 40, []
+
+    def standby_ingest():
+        try:
+            for i in range(n_chunks):
+                X = np.full((1, len(W)), float(i))
+                standby.append_ingest(X, [float(i)])
+        except BaseException as exc:   # surfaced after join
+            errors.append(exc)
+
+    th = threading.Thread(target=standby_ingest, daemon=True)
+    th.start()
+    # compact repeatedly while the other writer streams appends;
+    # watermark 0 + huge keep_rows => every ingest chunk is retained
+    for _ in range(8):
+        active.compact(watermark=0, wins=0, keep_rows=10**9)
+    th.join(30.0)
+    assert not th.is_alive() and not errors
+    active.compact(watermark=0, wins=0, keep_rows=10**9)
+    labels = sorted(int(e["labels"][0]) for e in active.events("ingest"))
+    assert labels == list(range(n_chunks))
 
 
 # -------------------------------------------------------------- failover
