@@ -219,6 +219,13 @@ class Application:
         fleet_on = bool(cfg.fleet_dir) or bool(cfg.fleet_url)
         fleet_trainer = fleet_on and cfg.fleet_role == "trainer"
         fleet_replica = fleet_on and cfg.fleet_role == "replica"
+        import socket
+        holder = "%s:%d" % (socket.gethostname(), os.getpid())
+        if fleet_on:
+            # stamp this process's fleet identity into the span tracer so
+            # merged multi-process Perfetto loads keep nodes apart
+            from .obs_trace import tracer
+            tracer.set_identity(role=cfg.fleet_role, holder=holder)
         if fleet_trainer and not cfg.online_train:
             Log.fatal("fleet_role=trainer requires online_train=true (the "
                       "trainer is the process that publishes promotions)")
@@ -296,14 +303,13 @@ class Application:
             if online_cfg is not None:
                 model_online = dict(online_cfg)
                 if fleet_trainer:
-                    import socket
                     model_online.update(
                         store=store, replay=cfg.fleet_replay,
                         lease_ttl_s=cfg.fleet_lease_ttl_s,
-                        holder_id="%s:%d" % (socket.gethostname(),
-                                             os.getpid()),
+                        holder_id=holder,
                         compact_bytes=cfg.fleet_compact_bytes,
-                        keep_artifacts=cfg.fleet_keep_artifacts)
+                        keep_artifacts=cfg.fleet_keep_artifacts,
+                        heartbeat_interval_s=cfg.fleet_heartbeat_interval_s)
             entry = registry.register(
                 mid, booster,
                 buckets=cfg.serve_buckets or None,
@@ -322,7 +328,9 @@ class Application:
                     entry.booster, store,
                     poll_interval_s=cfg.fleet_poll_interval_s,
                     applied_version=applied,
-                    backoff_max_s=cfg.fleet_backoff_max_s)
+                    backoff_max_s=cfg.fleet_backoff_max_s,
+                    heartbeat_interval_s=cfg.fleet_heartbeat_interval_s,
+                    node_id=holder)
         server = PredictServer(registry=registry, host=cfg.serve_host,
                                port=cfg.serve_port)
         server.fleet_watcher = watcher
@@ -388,7 +396,23 @@ class Application:
                 # one serve entry per process lifetime: the serving
                 # latency histograms + device-cost section at drain time
                 from . import obs_ledger
-                obs_ledger.record_run(cfg, "serve", 0, 0)
+                extra = None
+                if fleet_on:
+                    # record what this process actually WAS (a standby
+                    # that never won the lease ledgers as standby, not
+                    # trainer) so `ledger list` tells fleet runs apart
+                    role, epoch = cfg.fleet_role, 0
+                    try:
+                        ent = registry.get()
+                        if ent.online is not None:
+                            st = ent.online.state()
+                            role = st.get("role", role)
+                            epoch = int(st.get("lease_epoch", 0))
+                    except Exception:
+                        pass
+                    extra = {"fleet": {"role": role, "holder": holder,
+                                       "lease_epoch": epoch}}
+                obs_ledger.record_run(cfg, "serve", 0, 0, extra=extra)
         Log.info("serve: drained and closed")
 
 

@@ -280,6 +280,10 @@ class Config:
     fleet_timeout_s: float = 5.0      # remote transport per-request timeout
     fleet_backoff_max_s: float = 10.0  # cap for replica poll backoff and
     #   remote transport retry backoff
+    fleet_heartbeat_interval_s: float = 0.0  # federation cadence: every
+    #   node (trainer/standby/replica) records a compact heartbeat to the
+    #   store (remote replicas POST /fleet/heartbeat) for the
+    #   /fleet/status + fleetctl rollup. 0 = heartbeats off
 
     # ---- objective (reference: config.h "Objective Parameters") ----
     num_class: int = 1
@@ -522,6 +526,10 @@ class Config:
         if self.fleet_timeout_s <= 0:
             Log.fatal("fleet_timeout_s must be > 0, got %g",
                       self.fleet_timeout_s)
+        if self.fleet_heartbeat_interval_s < 0:
+            Log.fatal("fleet_heartbeat_interval_s must be >= 0 "
+                      "(0 disables heartbeats), got %g",
+                      self.fleet_heartbeat_interval_s)
         if self.fleet_backoff_max_s < self.fleet_poll_interval_s:
             Log.fatal("fleet_backoff_max_s must be >= "
                       "fleet_poll_interval_s, got %g < %g",

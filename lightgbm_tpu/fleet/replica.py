@@ -28,13 +28,27 @@ publish — pinned in tests/test_fleet.py).
 """
 from __future__ import annotations
 
+import os
 import threading
 import time
-from typing import Any, Dict, Optional
+from collections import deque
+from typing import Any, Dict, List, Optional
 
 from ..obs import telemetry
 from ..obs_trace import tracer
 from ..utils.log import LightGBMError, Log
+
+#: per-watcher publish->adopt lag samples kept for heartbeat p50/p99
+_LAG_WINDOW = 64
+
+
+def _percentile(sorted_vals: List[float], q: float) -> Optional[float]:
+    """Nearest-rank percentile of an ascending list (None when empty)."""
+    if not sorted_vals:
+        return None
+    idx = max(0, min(len(sorted_vals) - 1,
+                     int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
 
 
 def bootstrap_model(store):
@@ -87,6 +101,9 @@ class ReplicaWatcher:
                  poll_interval_s: float = 0.5,
                  applied_version: int = 0,
                  backoff_max_s: float = 10.0,
+                 heartbeat_interval_s: float = 0.0,
+                 node_id: Optional[str] = None,
+                 role: str = "replica",
                  start: bool = True) -> None:
         if poll_interval_s <= 0:
             raise LightGBMError("fleet poll_interval_s must be > 0, "
@@ -111,6 +128,20 @@ class ReplicaWatcher:
         self._last_error = ""
         self._last_swap_ts = 0.0
         self._stopped = False
+        # convergence observability: newest head version seen on the
+        # store, publish->adopt lag of the last swap plus a bounded
+        # sample window for heartbeat p50/p99, consecutive poll errors
+        # (reset on success — /healthz surfaces "is it failing NOW")
+        self._head_version = int(applied_version)
+        self._last_adopt_lag_ms: Optional[float] = None
+        self._lag_samples: deque = deque(maxlen=_LAG_WINDOW)
+        self._consec_errors = 0
+        self._node = str(node_id) if node_id else "pid-%d" % os.getpid()
+        self._role = str(role)
+        self._hb_interval = float(heartbeat_interval_s)
+        self._hb_last = 0.0
+        self._hb_sent = 0
+        self._hb_errors = 0
         telemetry.gauge("fleet/applied_version", self._applied)
         self._thread: Optional[threading.Thread] = None
         if start:
@@ -122,13 +153,31 @@ class ReplicaWatcher:
     # ----------------------------------------------------------------- polling
     def poll_once(self) -> bool:
         """Check the store once; adopt a newer version if one was
-        published. Returns True when a swap happened."""
+        published. Returns True when a swap happened.
+
+        When serve tracing is on the whole poll runs under a fresh
+        trace id — the transport forwards it as ``X-Trace-Id``, so a
+        remote adoption shows up in the trainer's recorder under the
+        SAME id as the replica's poll/swap spans (one cross-process
+        trace in a merged Perfetto load)."""
+        if not tracer.serve_on:
+            return self._poll_impl()
+        with tracer.span("fleet/replica_poll", domain="serve",
+                         trace_id=tracer.new_trace_id(),
+                         node=self._node):
+            return self._poll_impl()
+
+    def _poll_impl(self) -> bool:
+        telemetry.count("fleet/replica_polls")
         latest = self._store.latest_publish()
         if latest is None:
             return False
+        head = int(latest["version"])
         with self._lock:
             applied = self._applied
-        if int(latest["version"]) <= applied:
+            self._head_version = head
+        telemetry.gauge("fleet/version_skew", max(0, head - applied))
+        if head <= applied:
             return False
         # checksum-verified fetch, falling back past corrupt artifacts;
         # build the private candidate off-lock, then adopt — ONE version
@@ -141,12 +190,24 @@ class ReplicaWatcher:
         with tracer.span("fleet/replica_swap", domain="serve",
                          version=version):
             self._booster.adopt(candidate)
+        now = time.time()  # graftlint: disable=naked-timer -- epoch timestamp, not a duration
+        # publish->adopt convergence lag: the publish event is stamped
+        # with the trainer's wall clock (store._stamp), so the delta is
+        # exactly how stale this replica was when it caught up
+        ev_ts = float(event.get("ts", 0.0) or 0.0)
+        lag_ms = max(0.0, (now - ev_ts) * 1e3) if ev_ts > 0.0 else None
         with self._lock:
             self._applied = version
             self._swaps += 1
-            self._last_swap_ts = time.time()  # graftlint: disable=naked-timer -- epoch timestamp, not a duration
+            self._last_swap_ts = now
+            if lag_ms is not None:
+                self._last_adopt_lag_ms = lag_ms
+                self._lag_samples.append(lag_ms)
         telemetry.count("fleet/replica_swaps")
         telemetry.gauge("fleet/applied_version", version)
+        telemetry.gauge("fleet/version_skew", max(0, head - version))
+        if lag_ms is not None:
+            telemetry.observe("fleet/publish_adopt_lag_ms", lag_ms)
         Log.info("fleet: replica adopted published model v%d (%s)",
                  version, event.get("event"))
         return True
@@ -165,6 +226,7 @@ class ReplicaWatcher:
                 with self._lock:
                     had_backoff = self._backoff > 0
                     self._backoff = 0.0
+                    self._consec_errors = 0
                 if had_backoff:
                     telemetry.gauge("fleet/poll_backoff_ms", 0.0)
             except Exception as exc:
@@ -172,6 +234,7 @@ class ReplicaWatcher:
                 # the watcher: count it, back off, retry
                 with self._lock:
                     self._errors += 1
+                    self._consec_errors += 1
                     self._last_error = "%s: %s" % (type(exc).__name__, exc)
                     self._backoff = min(
                         self._backoff_max,
@@ -183,6 +246,60 @@ class ReplicaWatcher:
                                 backoff * 1000.0)
                 Log.warning("fleet: replica poll failed (backoff %gs): "
                             "%s: %s", backoff, type(exc).__name__, exc)
+            try:
+                self.maybe_heartbeat()
+            except Exception:
+                # heartbeats are observability: a store that cannot take
+                # one must not perturb the poll/backoff loop
+                with self._lock:
+                    self._hb_errors += 1
+                telemetry.count("fleet/heartbeat_errors")
+
+    # -------------------------------------------------------------- heartbeats
+    def heartbeat_doc(self) -> Dict[str, Any]:
+        """Compact node summary recorded to the store each heartbeat
+        (role, version, skew, lag percentiles, key counters) — the unit
+        the ``/fleet/status`` rollup federates."""
+        with self._lock:
+            lags = sorted(self._lag_samples)
+            return {
+                "node": self._node,
+                "role": self._role,
+                "pid": os.getpid(),
+                "version": self._applied,
+                "head_version": self._head_version,
+                "skew": max(0, self._head_version - self._applied),
+                "swaps": self._swaps,
+                "poll_errors": self._errors,
+                "consec_poll_errors": self._consec_errors,
+                "poll_backoff_s": self._backoff,
+                "last_swap_ts": self._last_swap_ts,
+                "lag_ms": {
+                    "last": self._last_adopt_lag_ms,
+                    "p50": _percentile(lags, 0.50),
+                    "p99": _percentile(lags, 0.99),
+                },
+            }
+
+    def maybe_heartbeat(self, force: bool = False) -> bool:
+        """Record a heartbeat when one is due (``heartbeat_interval_s``
+        elapsed; 0 disables unless ``force``). Duck-tolerant: a store
+        without ``record_heartbeat`` is a no-op."""
+        if self._hb_interval <= 0 and not force:
+            return False
+        record = getattr(self._store, "record_heartbeat", None)
+        if record is None:
+            return False
+        now = time.monotonic()  # graftlint: disable=naked-timer -- heartbeat cadence clock, not a measured duration
+        with self._lock:
+            if not force and now - self._hb_last < self._hb_interval:
+                return False
+            self._hb_last = now
+        if not record(self.heartbeat_doc()):
+            return False
+        with self._lock:
+            self._hb_sent += 1
+        return True
 
     # ------------------------------------------------------------------- state
     @property
@@ -196,13 +313,24 @@ class ReplicaWatcher:
             return {
                 "running": self._thread.is_alive()
                 if self._thread is not None else False,
+                "node": self._node,
+                "role": self._role,
                 "applied_version": self._applied,
+                "head_version": self._head_version,
+                "version_skew": max(0, self._head_version - self._applied),
                 "swaps": self._swaps,
                 "poll_errors": self._errors,
+                "consec_poll_errors": self._consec_errors,
                 "poll_backoff_s": self._backoff,
                 "last_error": self._last_error,
                 "last_swap_ts": self._last_swap_ts,
+                "last_adopt_lag_ms": self._last_adopt_lag_ms,
                 "poll_interval_s": self._poll,
+                "heartbeats": {
+                    "interval_s": self._hb_interval,
+                    "sent": self._hb_sent,
+                    "errors": self._hb_errors,
+                },
             }
 
     # ---------------------------------------------------------------- shutdown

@@ -62,6 +62,7 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import re
 import threading
 import time
 from contextlib import contextmanager
@@ -153,6 +154,7 @@ class FleetStore:
         self._events_path = os.path.join(self._dir, "events.jsonl")
         self._models_dir = os.path.join(self._dir, "models")
         self._lease_path = os.path.join(self._dir, "lease.json")
+        self._heartbeats_dir = os.path.join(self._dir, "heartbeats")
         os.makedirs(self._models_dir, exist_ok=True)
         # guards version allocation, the fence, compaction's rewrite and
         # the state counters; re-entrant because publish/compact append
@@ -889,6 +891,62 @@ class FleetStore:
                 "row_base": int(new_row_base),
                 "log_bytes": self.log_bytes()}
 
+    # ------------------------------------------------------------- heartbeats
+    def record_heartbeat(self, doc: Dict[str, Any]) -> bool:
+        """Persist one node heartbeat, latest-wins.
+
+        Heartbeats are observability, not replicated state: each node
+        owns ONE small sidecar file under ``heartbeats/`` that is
+        atomically replaced on every beat, so N nodes occupy O(N) bytes
+        no matter how long they run — heartbeats never touch
+        ``events.jsonl`` (replay and compaction stay bit-identical) and
+        read-only replica opens may record them (the ``read_only``
+        contract protects the event log and artifacts, not sidecar
+        observability). Returns False when ``doc`` carries no usable
+        ``node`` id."""
+        node = str(doc.get("node") or "").strip()
+        if not node:
+            return False
+        entry = self._stamp("heartbeat", dict(doc))
+        entry["node"] = node
+        fname = re.sub(r"[^A-Za-z0-9_.-]", "_", node)[:80] + ".json"
+        os.makedirs(self._heartbeats_dir, exist_ok=True)
+        path = os.path.join(self._heartbeats_dir, fname)
+        tmp = "%s.tmp.%d" % (path, os.getpid())
+        with open(tmp, "w") as f:
+            f.write(json.dumps(entry, sort_keys=True))
+        os.replace(tmp, path)
+        telemetry.count("fleet/heartbeats_recorded")
+        return True
+
+    def heartbeats(self, max_age_s: Optional[float] = None
+                   ) -> List[Dict[str, Any]]:
+        """Latest heartbeat per node (sorted by node id), skipping
+        torn/corrupt files; ``max_age_s`` filters out beats from nodes
+        that stopped reporting that long ago."""
+        try:
+            names = sorted(os.listdir(self._heartbeats_dir))
+        except OSError:
+            return []
+        now = time.time()  # graftlint: disable=naked-timer -- epoch timestamp, not a duration
+        out: List[Dict[str, Any]] = []
+        for name in names:
+            if not name.endswith(".json"):
+                continue
+            try:
+                with open(os.path.join(self._heartbeats_dir, name)) as f:
+                    doc = json.load(f)
+            except (OSError, ValueError):
+                continue
+            if not isinstance(doc, dict) or not doc.get("node"):
+                continue
+            if (max_age_s is not None
+                    and now - float(doc.get("ts", 0.0)) > max_age_s):
+                continue
+            out.append(doc)
+        out.sort(key=lambda d: str(d.get("node")))
+        return out
+
     # ------------------------------------------------------------------ state
     def state(self) -> Dict[str, Any]:
         """JSON-serializable store summary (surfaced on /healthz)."""
@@ -905,4 +963,5 @@ class FleetStore:
                 "compactions": self._compactions,
                 "last_compaction_ts": self._last_compact_ts,
                 "orphan_artifacts_reaped": self._orphans_reaped,
+                "heartbeat_nodes": len(self.heartbeats()),
             }

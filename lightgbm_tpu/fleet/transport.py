@@ -34,6 +34,7 @@ import urllib.request
 from typing import Any, Dict, Optional, Tuple
 
 from ..obs import telemetry
+from ..obs_trace import TRACE_HEADER, format_trace_id, tracer
 from ..utils.log import LightGBMError, Log
 from . import chaos
 from .store import CorruptArtifactError, _verify_artifact
@@ -41,6 +42,7 @@ from .store import CorruptArtifactError, _verify_artifact
 _LATEST = "/fleet/latest"
 _PUBLISHES = "/fleet/publishes"
 _ARTIFACT = "/fleet/artifact/%d"
+_HEARTBEAT = "/fleet/heartbeat"
 
 
 class TransportError(LightGBMError):
@@ -90,6 +92,7 @@ class RemoteStore:
         self._retried = 0
         self._errors = 0
         self._checksum_failures = 0
+        self._heartbeats_sent = 0
         self._last_error = ""
         self._corrupt_seen: set = set()
 
@@ -107,11 +110,21 @@ class RemoteStore:
         return min(self._backoff_max,
                    self._backoff_base * (2.0 ** attempt)) * factor
 
-    def _request(self, path: str) -> bytes:
-        """GET ``path`` with retries. Raises :class:`_NotFound` on 404
-        (no retry — absence is an answer) and :class:`TransportError`
-        once every attempt failed."""
+    def _request(self, path: str, data: Optional[bytes] = None) -> bytes:
+        """GET ``path`` (POST when ``data`` is given) with retries.
+        Raises :class:`_NotFound` on 404 (no retry — absence is an
+        answer) and :class:`TransportError` once every attempt failed.
+
+        The active span's trace id (if any) rides along as
+        ``X-Trace-Id`` so the trainer-side handler can join its serve
+        spans to the replica's poll trace."""
         last: Optional[BaseException] = None
+        headers = {}
+        trace_id = tracer.current_trace_id()
+        if trace_id is not None:
+            headers[TRACE_HEADER] = format_trace_id(trace_id)
+        if data is not None:
+            headers["Content-Type"] = "application/json"
         for attempt in range(self._retries + 1):
             if attempt > 0:
                 with self._lock:
@@ -126,7 +139,9 @@ class RemoteStore:
             telemetry.count("fleet/transport_requests")
             try:
                 act = chaos.hit("transport/request")
-                with urllib.request.urlopen(self._base + path,
+                req = urllib.request.Request(self._base + path, data=data,
+                                             headers=headers)
+                with urllib.request.urlopen(req,
                                             timeout=self._timeout) as resp:
                     body = resp.read()
                 if act is not None and act[0] == "torn":
@@ -143,8 +158,9 @@ class RemoteStore:
             self._errors += 1
             self._last_error = "%s: %s" % (type(last).__name__, last)
         telemetry.count("fleet/transport_errors")
-        raise TransportError("GET %s%s failed after %d attempt(s): %s: %s"
-                             % (self._base, path, self._retries + 1,
+        raise TransportError("%s %s%s failed after %d attempt(s): %s: %s"
+                             % ("POST" if data is not None else "GET",
+                                self._base, path, self._retries + 1,
                                 type(last).__name__, last))
 
     # ------------------------------------------------------- store duck-typing
@@ -196,6 +212,24 @@ class RemoteStore:
                                 type(exc).__name__, exc)
         return None
 
+    def record_heartbeat(self, doc: Dict[str, Any]) -> bool:
+        """POST a node heartbeat to the trainer's ``/fleet/heartbeat``.
+
+        Duck-types :meth:`FleetStore.record_heartbeat` so remote
+        replicas federate into the same ``/fleet/status`` rollup as
+        shared-filesystem nodes. Returns False (without retrying the
+        whole backoff ladder into an error) when the trainer predates
+        the endpoint (404) — heartbeats are observability, not state."""
+        body = json.dumps(doc, sort_keys=True).encode("utf-8")
+        try:
+            self._request(_HEARTBEAT, data=body)
+        except _NotFound:
+            return False
+        with self._lock:
+            self._heartbeats_sent += 1
+        telemetry.count("fleet/heartbeats_sent")
+        return True
+
     # ------------------------------------------------------------------ state
     def state(self) -> Dict[str, Any]:
         """JSON-serializable transport summary (surfaced on /healthz)."""
@@ -206,6 +240,7 @@ class RemoteStore:
                 "retries": self._retried,
                 "errors": self._errors,
                 "checksum_failures": self._checksum_failures,
+                "heartbeats_sent": self._heartbeats_sent,
                 "last_error": self._last_error,
                 "timeout_s": self._timeout,
             }

@@ -16,10 +16,20 @@ interprocedural rules landed):
 - :mod:`.threads`   — ``lock-discipline`` (thread roots x shared state),
   ``unnamed-thread`` (every Thread must be name=d for span traces)
 - :mod:`.tracer`    — ``tracer-leak`` (python control flow on traced values)
+- :mod:`.metricname` — ``metric-name`` (Prometheus family hygiene:
+  sanitize-ambiguous names, one family under two types)
 """
 from ..astutil import (  # noqa: F401  (re-exported for rule authors/tests)
     canonical_call,
     dotted,
     import_aliases,
 )
-from . import dtypes, hostsync, structure, threads, timing, tracer  # noqa: F401
+from . import (  # noqa: F401
+    dtypes,
+    hostsync,
+    metricname,
+    structure,
+    threads,
+    timing,
+    tracer,
+)

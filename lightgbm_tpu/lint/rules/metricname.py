@@ -1,0 +1,124 @@
+"""metric-name: Prometheus family hygiene for the telemetry registry.
+
+``obs.prometheus_text`` renders every registry key through
+``_prom_name`` (sanitize + ``lgbtpu_`` prefix) and a per-kind suffix
+convention (counters ``_total``, timers ``_seconds_total`` +
+``_calls_total``, gauges bare, histograms ``_bucket``/``_sum``/
+``_count`` under the bare family). Two source-level mistakes survive
+that rendering and corrupt the exposition downstream:
+
+- a raw name with characters outside the blessed set (letters, digits,
+  ``_:`` plus the ``/`` and ``.`` separators) sanitizes to ``_`` — two
+  DIFFERENT source names can silently merge into one family, and the
+  emitted family no longer reflects the source name;
+- one family registered under two different types (e.g. the same name
+  fed to both ``gauge`` and ``observe``): the exporter's first-family-
+  wins dedupe drops one silently, and strict parsers reject a family
+  with two ``# TYPE`` lines.
+
+This rule resolves every *literal* registration site project-wide to
+its emitted family name(s) and flags both. Dynamic names
+(``"span_ms/" + name``) cannot be checked statically and are skipped.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Tuple
+
+from ..core import Finding, Project, Rule, SourceFile, register
+
+#: Telemetry methods that create an exposition family, mapped to the
+#: (suffix, prometheus type) pairs obs.prometheus_text emits for them
+_METHOD_FAMILIES = {
+    "count": (("_total", "counter"),),
+    "gauge": (("", "gauge"),),
+    "add_time": (("_seconds_total", "counter"), ("_calls_total", "counter")),
+    "timed": (("_seconds_total", "counter"), ("_calls_total", "counter")),
+    "observe": (("", "histogram"),),
+    "timed_observe": (("", "histogram"),),
+}
+
+#: the exposition-legal family shape (Prometheus data model)
+_FAMILY_RE = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*\Z")
+
+#: characters a raw registry key may use: family-legal chars plus the
+#: repo's two separator conventions ("/" and "."), which _prom_name
+#: maps to "_" deterministically
+_RAW_OK_RE = re.compile(r"[a-zA-Z0-9_:./]+\Z")
+
+
+def _prom(name: str) -> str:
+    # mirror of obs._prom_name — the linter must predict the exact
+    # family the exporter will emit
+    return "lgbtpu_" + re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+
+
+def _registrations(f: SourceFile) -> Iterator[Tuple[ast.Call, str, str]]:
+    """(call node, raw name, method) for every literal-name telemetry
+    registration in ``f``. Receiver must BE (or end in) ``telemetry`` so
+    ``itertools.count(...)`` / local histogram objects don't match."""
+    for node in f.walk_nodes():
+        if not isinstance(node, ast.Call) \
+                or not isinstance(node.func, ast.Attribute):
+            continue
+        method = node.func.attr
+        if method not in _METHOD_FAMILIES:
+            continue
+        recv = node.func.value
+        recv_name = recv.id if isinstance(recv, ast.Name) \
+            else recv.attr if isinstance(recv, ast.Attribute) else None
+        if recv_name != "telemetry":
+            continue
+        if not node.args:
+            continue
+        first = node.args[0]
+        if not (isinstance(first, ast.Constant)
+                and isinstance(first.value, str)):
+            continue   # dynamic name: not statically checkable
+        yield node, first.value, method
+
+
+@register
+class MetricNameRule(Rule):
+    """Telemetry registrations must yield exposition-legal Prometheus
+    family names, and one family must not be registered under two
+    different types (first-family-wins would silently drop one)."""
+
+    id = "metric-name"
+    description = ("telemetry metric name sanitizes ambiguously, or one "
+                   "Prometheus family is registered under two types")
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        # family -> (type, "file:line" of first registration)
+        seen: Dict[str, Tuple[str, str]] = {}
+        sites: List[Tuple[SourceFile, ast.Call, str, str]] = []
+        for f in project.files:
+            for node, raw, method in _registrations(f):
+                sites.append((f, node, raw, method))
+        # deterministic order: findings independent of file walk order
+        sites.sort(key=lambda s: (s[0].rel, s[1].lineno, s[1].col_offset))
+        for f, node, raw, method in sites:
+            if not raw or not _RAW_OK_RE.match(raw):
+                yield f.finding(
+                    node, self.id,
+                    "metric name %r sanitizes ambiguously; use only "
+                    "[a-zA-Z0-9_:] with / or . as separators" % raw)
+                continue
+            for suffix, ptype in _METHOD_FAMILIES[method]:
+                family = _prom(raw) + suffix
+                if not _FAMILY_RE.match(family):
+                    yield f.finding(
+                        node, self.id,
+                        "family %r is not a legal Prometheus metric "
+                        "name" % family)
+                    continue
+                prev = seen.get(family)
+                if prev is None:
+                    seen[family] = (ptype, "%s:%d" % (f.rel, node.lineno))
+                elif prev[0] != ptype:
+                    yield f.finding(
+                        node, self.id,
+                        "family %r registered as %s here but as %s at "
+                        "%s; one family, one type"
+                        % (family, ptype, prev[0], prev[1]))

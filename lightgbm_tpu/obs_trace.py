@@ -48,6 +48,31 @@ monotonic = time.perf_counter
 DEFAULT_CAPACITY = 65536
 MODES = ("off", "on", "serve_only")
 
+# HTTP header carrying a trace id across process boundaries (client ->
+# /predict, replica transport -> trainer /fleet endpoints).  The value
+# is the decimal trace id; foreign ids (non-numeric) are carried opaque.
+TRACE_HEADER = "X-Trace-Id"
+
+
+def format_trace_id(trace_id):
+    """Trace id -> header value."""
+    return str(trace_id)
+
+
+def parse_trace_id(value):
+    """Header value -> trace id (int when it parses, else the raw string
+    bounded to 128 chars so a hostile header cannot bloat spans), or
+    None for absent/blank values."""
+    if not value:
+        return None
+    value = value.strip()
+    if not value:
+        return None
+    try:
+        return int(value, 10)
+    except ValueError:
+        return value[:128]
+
 # histogram family for per-phase timings, fed on every span end while
 # tracing is on (per-phase train timings / serve stage timings)
 _SPAN_HIST_PREFIX = "span_ms/"
@@ -127,6 +152,10 @@ class SpanTracer(object):
         self._ids = itertools.count(1)
         self._tls = threading.local()
         self._trace_state_clean = _trace_state_clean_fallback
+        # fleet process identity: stamped into chrome_trace process_name
+        # so merged multi-process exports keep nodes distinguishable
+        self.identity_role = None
+        self.identity_holder = None
 
     # ------------------------------------------------------------- setup
     def configure(self, mode, capacity=None):
@@ -151,7 +180,35 @@ class SpanTracer(object):
         return self
 
     def new_trace_id(self):
-        return next(self._ids)
+        # pid-salted so ids minted by different fleet processes never
+        # collide when their traces are merged into one Perfetto load;
+        # getpid() is read per call so forked children stay distinct
+        return ((os.getpid() & 0x3FFFFF) << 40) | next(self._ids)
+
+    def set_identity(self, role=None, holder=None):
+        """Label this process for multi-process trace merges (fleet
+        role + holder id; cli serve sets this when fleet mode is on)."""
+        with self._lock:
+            self.identity_role = role
+            self.identity_holder = holder
+
+    def identity(self):
+        """JSON-serializable process identity (pid always present)."""
+        with self._lock:
+            role, holder = self.identity_role, self.identity_holder
+        doc = {"pid": os.getpid()}
+        if role:
+            doc["role"] = role
+        if holder:
+            doc["holder"] = holder
+        return doc
+
+    def current_trace_id(self):
+        """Trace id of the innermost open span on this thread (None when
+        no span is open) — lets the fleet transport propagate the active
+        request's id over HTTP without threading it through every call."""
+        stack = getattr(self._tls, "stack", None)
+        return stack[-1].trace_id if stack else None
 
     # ----------------------------------------------------------- spanning
     def _stack(self):
@@ -232,6 +289,7 @@ class SpanTracer(object):
         with self._lock:
             spans = list(self._ring)
             epoch = self._epoch
+            id_role, id_holder = self.identity_role, self.identity_holder
         pid = os.getpid()
         threads = {}
         events = []
@@ -246,8 +304,12 @@ class SpanTracer(object):
             if args:
                 ev["args"] = args
             events.append(ev)
+        pname = "lightgbm-tpu"
+        if id_role or id_holder:
+            pname += " [%s]" % " ".join(
+                str(x) for x in (id_role, id_holder) if x)
         meta = [{"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
-                 "args": {"name": "lightgbm-tpu"}}]
+                 "args": {"name": pname}}]
         for tid in sorted(threads):
             meta.append({"name": "thread_name", "ph": "M", "pid": pid,
                          "tid": tid, "args": {"name": threads[tid]}})

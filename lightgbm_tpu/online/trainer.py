@@ -220,6 +220,7 @@ class OnlineTrainer:
                  holder_id: Optional[str] = None,
                  compact_bytes: int = 0,
                  keep_artifacts: int = 0,
+                 heartbeat_interval_s: float = 0.0,
                  candidate_factory=None,
                  start: bool = True) -> None:
         if mode not in MODES:
@@ -252,6 +253,10 @@ class OnlineTrainer:
         if compact_bytes < 0 or keep_artifacts < 0:
             raise LightGBMError("online compact_bytes/keep_artifacts "
                                 "must be >= 0")
+        if heartbeat_interval_s < 0:
+            raise LightGBMError("online heartbeat_interval_s must be "
+                                ">= 0 (0 disables heartbeats), got %g"
+                                % heartbeat_interval_s)
         if lease_ttl_s > 0 and store is None:
             raise LightGBMError("online lease_ttl_s needs a fleet store "
                                 "to hold the lease in")
@@ -336,6 +341,12 @@ class OnlineTrainer:
         self._lease_epoch = 0
         self._lease_lost = 0
         self._last_renew_t = obs.monotonic()
+        # fleet federation: periodic heartbeats into the store's sidecar
+        # (role/version/lease/counters) for the /fleet/status rollup
+        self._hb_interval = float(heartbeat_interval_s)
+        self._hb_last = 0.0
+        self._hb_sent = 0
+        self._hb_errors = 0
         if self._store is not None and replay and not self._standby:
             self._replay()
         # pre-touch the promotion counters so a freshly-started online
@@ -489,7 +500,11 @@ class OnlineTrainer:
                 self._lock.wait(timeout=poll)
                 if self._stopped:
                     return
-            if self._lease_ttl > 0 and not self._lease_tick():
+            active = self._lease_ttl <= 0 or self._lease_tick()
+            # standbys heartbeat too: the /fleet/status rollup must show
+            # the warm spare waiting on the lease, not just the holder
+            self.maybe_heartbeat()
+            if not active:
                 continue   # standby (or just demoted): no watch, no train
             try:
                 # the live watch outranks training: a regressed model
@@ -617,6 +632,61 @@ class OnlineTrainer:
         Log.warning("fleet: %s lost the trainer lease (epoch %d) — "
                     "demoting to standby", self._holder, epoch)
         return False
+
+    # ------------------------------------------------------------- heartbeats
+    def heartbeat_doc(self) -> Dict[str, Any]:
+        """Compact node summary recorded to the store each heartbeat —
+        the trainer/standby half of the ``/fleet/status`` federation
+        (replicas record the watcher-side equivalent)."""
+        version = 0
+        if self._store is not None:
+            state = getattr(self._store, "state", None)
+            if state is not None:
+                try:
+                    version = int(state().get("last_published_version", 0))
+                except Exception:
+                    version = 0
+        with self._lock:
+            doc = {
+                "node": self._holder,
+                "role": ("standby" if self._standby else "active")
+                if self._lease_ttl > 0 else "solo",
+                "pid": os.getpid(),
+                "version": version,
+                "lease_epoch": self._lease_epoch,
+                "trains": self._trains,
+                "promotions": self._promotions,
+                "rejections": self._rejections,
+                "consumed_rows": self._consumed_rows,
+            }
+        doc["buffered_rows"] = self.buffer.rows
+        return doc
+
+    def maybe_heartbeat(self, force: bool = False) -> bool:
+        """Record a heartbeat when one is due (``heartbeat_interval_s``
+        elapsed; 0 disables unless ``force``). Never raises — a store
+        that cannot take a heartbeat must not perturb the train loop."""
+        if self._store is None or (self._hb_interval <= 0 and not force):
+            return False
+        record = getattr(self._store, "record_heartbeat", None)
+        if record is None:
+            return False
+        now = obs.monotonic()
+        with self._lock:
+            if not force and now - self._hb_last < self._hb_interval:
+                return False
+            self._hb_last = now
+        try:
+            ok = bool(record(self.heartbeat_doc()))
+        except Exception:
+            with self._lock:
+                self._hb_errors += 1
+            telemetry.count("fleet/heartbeat_errors")
+            return False
+        if ok:
+            with self._lock:
+                self._hb_sent += 1
+        return ok
 
     # ---------------------------------------------------------------- cycle
     def run_once(self) -> str:
@@ -883,6 +953,11 @@ class OnlineTrainer:
                 "lease_holder": self._holder
                 if self._lease_ttl > 0 else None,
                 "lease_lost": self._lease_lost,
+                "heartbeats": {
+                    "interval_s": self._hb_interval,
+                    "sent": self._hb_sent,
+                    "errors": self._hb_errors,
+                },
             }
         if self._store is not None:
             st["store"] = self._store.state()

@@ -17,6 +17,9 @@
     GET  /fleet/latest         newest fleet publish event (trainer mode)
     GET  /fleet/publishes      all valid publish events oldest-first
     GET  /fleet/artifact/<v>   raw whole-model artifact bytes
+    GET  /fleet/status         federated rollup: head version, lease,
+                               every node's latest heartbeat with skew
+    POST /fleet/heartbeat      remote nodes report their heartbeat docs
 
 The three /fleet routes exist when the CLI attaches a local
 ``FleetStore`` (``server.fleet_store``): they are the network transport
@@ -54,7 +57,7 @@ import numpy as np
 
 from .. import obs
 from ..obs import telemetry
-from ..obs_trace import tracer
+from ..obs_trace import TRACE_HEADER, format_trace_id, parse_trace_id, tracer
 from ..utils.log import LightGBMError, Log
 from .batcher import QueueFullError
 
@@ -127,11 +130,13 @@ class PredictServer:
             def log_message(self, fmt, *args):  # default writes to stderr
                 Log.debug("serve: " + fmt % args)
 
-            def _json(self, code: int, obj) -> None:
+            def _json(self, code: int, obj, headers=None) -> None:
                 body = json.dumps(obj).encode("utf-8")
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for key, value in (headers or {}).items():
+                    self.send_header(key, value)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -162,7 +167,21 @@ class PredictServer:
                 attached local store's publish feed + artifacts. A torn
                 chaos action truncates the response body (Content-Length
                 included, so the client's checksum — not a short-read
-                error — must catch it); a raise action answers 500."""
+                error — must catch it); a raise action answers 500.
+
+                When serve tracing is on, an ``X-Trace-Id`` sent by the
+                remote replica's transport joins this handler's span to
+                the replica's poll trace — the trainer half of the
+                cross-process adoption trace."""
+                if not tracer.serve_on:
+                    self._fleet_impl()
+                    return
+                tid = parse_trace_id(self.headers.get(TRACE_HEADER))
+                with tracer.span("serve/fleet_request", domain="serve",
+                                 trace_id=tid, path=self.path):
+                    self._fleet_impl()
+
+            def _fleet_impl(self) -> None:
                 store = server.fleet_store
                 if store is None:
                     self._json(404, {"error": "no fleet store attached"})
@@ -183,7 +202,10 @@ class PredictServer:
                     self._raw(200, body, ctype)
 
                 seg = [s for s in self.path.split("/") if s]
-                if seg == ["fleet", "latest"]:
+                if seg == ["fleet", "status"]:
+                    send(json.dumps(server.fleet_status())
+                         .encode("utf-8"), "application/json")
+                elif seg == ["fleet", "latest"]:
                     latest = store.latest_publish()
                     if latest is None:
                         self._json(404, {"error": "nothing published yet"})
@@ -217,6 +239,12 @@ class PredictServer:
                 except Exception as exc:
                     self._json(400, {"error": "bad request body: %s" % exc})
                     return
+                if self.path == "/fleet/heartbeat":
+                    # federation intake: remote nodes POST their
+                    # heartbeats here; observability stays up while the
+                    # serve plane drains, so this precedes the 503 gate
+                    self._fleet_heartbeat(payload)
+                    return
                 if server.draining():
                     telemetry.count("serve/drain_rejected")
                     self._json(503, {"error": "server is draining"})
@@ -238,7 +266,33 @@ class PredictServer:
                 else:
                     self._ingest(entry, payload)
 
+            def _fleet_heartbeat(self, payload) -> None:
+                store = server.fleet_store
+                if store is None:
+                    self._json(404, {"error": "no fleet store attached"})
+                    return
+                try:
+                    ok = store.record_heartbeat(
+                        payload if isinstance(payload, dict) else {})
+                except Exception as exc:
+                    self._json(500, {"error": "%s: %s"
+                                     % (type(exc).__name__, exc)})
+                    return
+                if not ok:
+                    self._json(400, {"error": "heartbeat needs a node id"})
+                    return
+                self._json(200, {"ok": True})
+
             def _predict(self, entry, payload) -> None:
+                # trace correlation: adopt the client's X-Trace-Id when
+                # sent, mint one otherwise, and echo it back on EVERY
+                # response so external clients can correlate against
+                # flight-recorder dumps (echoed even with tracing off —
+                # minting is one counter increment, no span records)
+                tid = parse_trace_id(self.headers.get(TRACE_HEADER)) \
+                    or tracer.new_trace_id()
+                echo = {TRACE_HEADER: format_trace_id(tid)}
+                span_tid = tid if tracer.serve_on else None
                 try:
                     X = np.asarray(payload["rows"], np.float64)
                     if X.ndim == 1:
@@ -248,24 +302,24 @@ class PredictServer:
                     # curl-friendly fallback, absent means "default"
                     tenant = self.headers.get("X-Tenant") \
                         or payload.get("tenant")
-                    tid = tracer.new_trace_id() if tracer.serve_on else None
                     with tracer.span("serve/http_request", domain="serve",
-                                     trace_id=tid, rows=int(X.shape[0]),
+                                     trace_id=span_tid, rows=int(X.shape[0]),
                                      model=entry.model_id):
-                        fut = entry.batcher.submit(X, trace_id=tid,
+                        fut = entry.batcher.submit(X, trace_id=span_tid,
                                                    tenant=tenant)
                         out = fut.result(timeout=server.request_timeout_s)
                     self._json(200, {"predictions": out.tolist(),
                                      "rows": int(X.shape[0]),
                                      "model_version":
-                                         entry.booster.inner.model_version})
+                                         entry.booster.inner.model_version},
+                               echo)
                 except QueueFullError as exc:
                     # admission control shed: fast 429 beats unbounded
                     # queueing; clients back off or retry elsewhere
-                    self._json(429, {"error": "overloaded: %s" % exc})
+                    self._json(429, {"error": "overloaded: %s" % exc}, echo)
                 except Exception as exc:
                     self._json(400, {"error": "%s: %s"
-                                     % (type(exc).__name__, exc)})
+                                     % (type(exc).__name__, exc)}, echo)
 
             def _ingest(self, entry, payload) -> None:
                 if entry.online is None:
@@ -368,6 +422,35 @@ class PredictServer:
         except KeyError:
             pass
         return doc
+
+    def fleet_status(self) -> dict:
+        """The ``GET /fleet/status`` rollup: one document describing the
+        whole fleet from the trainer's vantage — store head version +
+        lease + log size, and every node's latest heartbeat (local
+        replicas and standbys write them straight to the store; remote
+        replicas POST them to ``/fleet/heartbeat``), each stamped with
+        server-side version skew and heartbeat age."""
+        store = self.fleet_store
+        if store is None:
+            return {"nodes": []}
+        st = store.state()
+        head = int(st["last_published_version"])
+        now = time.time()  # graftlint: disable=naked-timer -- epoch timestamp, not a duration
+        nodes = []
+        for hb in store.heartbeats():
+            node = dict(hb)
+            node["skew"] = max(0, head - int(node.get("version", 0) or 0))
+            node["age_s"] = round(max(0.0, now - float(node.get("ts", now))),
+                                  3)
+            nodes.append(node)
+        return {
+            "model_id": st["model_id"],
+            "head_version": head,
+            "lease": st["lease"],
+            "log_bytes": st["events_log_bytes"],
+            "compactions": st["compactions"],
+            "nodes": nodes,
+        }
 
     # ------------------------------------------------------------ lifecycle
     def serve_forever(self) -> None:

@@ -1,0 +1,147 @@
+"""Fleet status plane CLI: one trainer endpoint, the whole fleet.
+
+Usage:
+    python scripts/fleetctl.py status <trainer-url>   # per-node rollup
+    python scripts/fleetctl.py lag    <trainer-url>   # convergence lag
+    python scripts/fleetctl.py tail   <trainer-url> [-n 10]  # publishes
+
+``status`` renders ``GET /fleet/status``: store head version + lease
+state, then one row per node (trainer, standbys, replicas — local nodes
+heartbeat straight into the store, remote replicas POST theirs to
+``/fleet/heartbeat``) with role, model version, version skew vs head,
+publish->adopt lag (last/p50/p99 ms) and heartbeat age. ``lag`` is the
+convergence columns alone; ``tail`` renders the newest publish events
+from ``GET /fleet/publishes``.
+
+Stdlib-only on purpose: a laptop with no jax can point it at any
+trainer. Exit 1 when the endpoint is unreachable or fleet mode is off.
+"""
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+
+
+def fetch_json(url, path, timeout_s=5.0):
+    """GET <url><path> -> parsed JSON (raises URLError/HTTPError)."""
+    req = urllib.request.Request(url.rstrip("/") + path)
+    with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+        return json.loads(resp.read().decode("utf-8"))
+
+
+def fetch_status(url, timeout_s=5.0):
+    return fetch_json(url, "/fleet/status", timeout_s)
+
+
+def _ms(v):
+    return "-" if v is None else "%.1f" % float(v)
+
+
+def _lag_cell(node):
+    lag = node.get("lag_ms") or {}
+    if not isinstance(lag, dict) or lag.get("last") is None:
+        return "-"
+    return "%s/%s/%s" % (_ms(lag.get("last")), _ms(lag.get("p50")),
+                         _ms(lag.get("p99")))
+
+
+def _node_rows(doc):
+    rows = []
+    for node in doc.get("nodes", []):
+        rows.append((
+            str(node.get("node", "?")),
+            str(node.get("role", "?")),
+            str(node.get("version", "?")),
+            str(node.get("skew", "?")),
+            _lag_cell(node),
+            str(node.get("consec_poll_errors",
+                         node.get("poll_errors", 0))),
+            "%.1f" % float(node.get("poll_backoff_s", 0.0) or 0.0),
+            "%.1f" % float(node.get("age_s", 0.0) or 0.0),
+        ))
+    return rows
+
+
+def _render_nodes(doc):
+    header = ("NODE", "ROLE", "VER", "SKEW", "LAG ms(last/p50/p99)",
+              "ERRS", "BACKOFF s", "AGE s")
+    rows = _node_rows(doc)
+    if not rows:
+        return ["(no heartbeats yet — set fleet_heartbeat_interval_s>0 "
+                "on every node)"]
+    widths = [max(len(header[i]), max(len(r[i]) for r in rows))
+              for i in range(len(header))]
+    fmt = "  ".join("%%-%ds" % w for w in widths)
+    return [fmt % header] + [fmt % r for r in rows]
+
+
+def render_status(doc):
+    """``/fleet/status`` document -> printable lines."""
+    lease = doc.get("lease") or {}
+    lines = [
+        "model %s  head v%s  log %s B  compactions %s"
+        % (doc.get("model_id", "?"), doc.get("head_version", "?"),
+           doc.get("log_bytes", "?"), doc.get("compactions", "?")),
+        "lease %s"
+        % ("held by %s (epoch %s)" % (lease.get("holder"),
+                                      lease.get("epoch"))
+           if lease.get("held") else "free"),
+    ]
+    return lines + _render_nodes(doc)
+
+
+def render_lag(doc):
+    """Convergence-only view: skew + publish->adopt lag per node."""
+    return ["head v%s" % doc.get("head_version", "?")] + _render_nodes(doc)
+
+
+def render_tail(doc, n=10):
+    """``/fleet/publishes`` document -> the newest n publish lines."""
+    pubs = (doc.get("publishes") or [])[-int(n):]
+    if not pubs:
+        return ["(nothing published yet)"]
+    lines = []
+    for e in pubs:
+        ts = float(e.get("ts", 0.0) or 0.0)
+        when = time.strftime("%Y-%m-%d %H:%M:%S",
+                             time.localtime(ts)) if ts else "?"
+        lines.append("v%-6s %-19s %-10s epoch=%s"
+                     % (e.get("version", "?"), when,
+                        e.get("event", "?"), e.get("lease_epoch", 0)))
+    return lines
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="fleetctl", description=__doc__.splitlines()[0])
+    ap.add_argument("command", choices=("status", "lag", "tail"))
+    ap.add_argument("url", help="trainer base url, e.g. http://host:8080")
+    ap.add_argument("-n", type=int, default=10,
+                    help="tail: newest N publishes (default 10)")
+    ap.add_argument("--timeout", type=float, default=5.0)
+    args = ap.parse_args(argv)
+    try:
+        if args.command == "tail":
+            doc = fetch_json(args.url, "/fleet/publishes", args.timeout)
+            lines = render_tail(doc, args.n)
+        else:
+            doc = fetch_status(args.url, args.timeout)
+            lines = (render_status if args.command == "status"
+                     else render_lag)(doc)
+    except urllib.error.HTTPError as exc:
+        print("fleetctl: %s answered %d (fleet store attached?)"
+              % (args.url, exc.code), file=sys.stderr)
+        return 1
+    except (urllib.error.URLError, OSError, ValueError) as exc:
+        print("fleetctl: cannot reach %s: %s" % (args.url, exc),
+              file=sys.stderr)
+        return 1
+    for line in lines:
+        print(line)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

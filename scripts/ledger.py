@@ -68,18 +68,24 @@ def cmd_list(args):
     if not entries:
         print("ledger: no entries at %s" % args.path)
         return 0
-    print("%-4s %-19s %-6s %-8s %10s %5s  %-16s %s"
+    print("%-4s %-19s %-6s %-8s %10s %5s  %-16s %-5s %s"
           % ("idx", "ts", "kind", "backend", "rows", "feat",
-             "config_fp", "knobs"))
+             "config_fp", "knobs", "fleet"))
     base = len(_entries(args.path, args.kind))
     for i, e in enumerate(entries):
         ds, m = e.get("dataset", {}), e.get("machine", {})
-        print("%-4d %-19s %-6s %-8s %10s %5s  %-16s %d"
+        # serve entries from fleet runs carry role/holder/lease epoch so
+        # trainer vs standby vs replica processes tell apart at a glance
+        fl = (e.get("extra") or {}).get("fleet") or {}
+        ftxt = "%s@%s %s" % (fl.get("role", "?"),
+                             fl.get("lease_epoch", 0),
+                             fl.get("holder", "")) if fl else ""
+        print("%-4d %-19s %-6s %-8s %10s %5s  %-16s %-5d %s"
               % (i - len(entries) + base, _fmt_ts(e.get("ts", 0)),
                  e.get("kind", "?"), m.get("backend", "?"),
                  ds.get("rows", "?"), ds.get("features", "?"),
                  e.get("config_fp", "?"),
-                 len(e.get("resolved_knobs", {}))))
+                 len(e.get("resolved_knobs", {})), ftxt))
     return 0
 
 

@@ -330,13 +330,82 @@ def test_baseline_roundtrip(tmp_path):
         == {"version": 1, "findings": []}
 
 
+# ------------------------------------------------------------ metric-name
+
+def test_metric_name_flags_ambiguous_sanitization(tmp_path):
+    res = make_project(tmp_path, {"scripts/s.py": """\
+        from lightgbm_tpu.obs import telemetry
+        telemetry.count("serve requests")
+        telemetry.gauge("queue-depth")
+        telemetry.count("fleet/replica_polls")      # legal separators
+        telemetry.observe("span_ms/" + "dyn", 1.0)  # dynamic: skipped
+    """})
+    assert lines_hit(res, "metric-name") == [2, 3]
+    msgs = [f.message for f in res.findings if f.rule == "metric-name"]
+    assert all("sanitizes ambiguously" in m for m in msgs)
+
+
+def test_metric_name_flags_one_family_two_types(tmp_path):
+    res = make_project(tmp_path, {
+        "lightgbm_tpu/a.py": """\
+            from lightgbm_tpu.obs import telemetry
+            telemetry.gauge("fleet/skew")
+        """,
+        "lightgbm_tpu/b.py": """\
+            from lightgbm_tpu.obs import telemetry
+            telemetry.observe("fleet/skew", 2.0)
+        """,
+    })
+    (f,) = [x for x in res.findings if x.rule == "metric-name"]
+    # deterministic: the later site (file order) is the finding, the
+    # earlier one is the cited first registration
+    assert f.path == "lightgbm_tpu/b.py"
+    assert "lgbtpu_fleet_skew" in f.message
+    assert "one family, one type" in f.message
+    assert "lightgbm_tpu/a.py:2" in f.message
+
+
+def test_metric_name_counter_total_collides_with_gauge(tmp_path):
+    # the hazard lives in the SUFFIXED family: counter "x" emits
+    # lgbtpu_x_total, which a gauge literally named "x_total" collides
+    # with even though the raw registry keys differ
+    res = make_project(tmp_path, {"scripts/s.py": """\
+        from lightgbm_tpu.obs import telemetry
+        telemetry.count("ingest/rows")
+        telemetry.gauge("ingest/rows_total")
+    """})
+    (f,) = [x for x in res.findings if x.rule == "metric-name"]
+    assert "lgbtpu_ingest_rows_total" in f.message
+
+
+def test_metric_name_negative(tmp_path):
+    res = make_project(tmp_path, {"scripts/s.py": """\
+        from itertools import count
+        from lightgbm_tpu.obs import telemetry
+        ids = count(1)                       # not telemetry.count
+        next(ids)
+        telemetry.count("fleet/heartbeats_sent")
+        telemetry.count("fleet/heartbeats_sent", 2)   # same type: fine
+        telemetry.gauge("fleet/version_skew", 0)
+        telemetry.observe("fleet/publish_adopt_lag_ms", 1.0)
+        telemetry.add_time("wall/serve", 0.1)
+
+        class Thing:
+            def gauge(self, name, v):
+                pass
+
+        Thing().gauge("not a metric!", 1)    # receiver is not telemetry
+    """})
+    assert "metric-name" not in rules_hit(res)
+
+
 # ------------------------------------------------------------ framework
 
 def test_rule_registry_and_selection(tmp_path):
     ids = set(lint.all_rules())
     assert {"naked-timer", "host-sync", "implicit-dtype",
             "unnamed-pallas-call", "mutable-default",
-            "module-mutable-state"} <= ids
+            "module-mutable-state", "metric-name"} <= ids
     with pytest.raises(ValueError):
         lint.run(str(tmp_path), rules=["no-such-rule"])
 

@@ -184,6 +184,57 @@ def test_prometheus_name_collision_first_family_wins():
     assert samples["lgbtpu_a_b_total"] == 5
 
 
+def test_fleet_exposition_round_trips_every_family(tmp_path):
+    """ISSUE 15: after a fleet e2e run (trainer + replica + one publish
+    + heartbeats) EVERY counter and histogram family in the snapshot
+    round-trips through the strict exposition parser — including the
+    new ``lgbtpu_fleet_*`` convergence families."""
+    from lightgbm_tpu.fleet import FleetStore, ReplicaWatcher
+    from lightgbm_tpu.online import OnlineTrainer
+
+    X, y = _data(n=300)
+    bst = lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=4)
+    telemetry.reset()
+    store = FleetStore(str(tmp_path), "default")
+    trainer = OnlineTrainer(bst, trigger_rows=10**9, min_rows=64,
+                            shadow_rows=10**6, promote_threshold=2.0,
+                            promote_patience=2, store=store,
+                            holder_id="obs-trainer", start=False)
+    store.publish(bst.model_to_string(), event="boot")
+    replica = lgb.Booster(model_str=bst.model_to_string())
+    w = ReplicaWatcher(replica, store, node_id="obs-replica", start=False)
+    assert w.poll_once()
+    assert trainer.maybe_heartbeat(force=True)
+    assert w.maybe_heartbeat(force=True)
+    trainer.close()
+
+    snap = telemetry.snapshot(include_global_timer=False)
+    families, samples = parse_prometheus(obs.prometheus_text())
+    # the run actually exercised the new convergence families
+    for fam, kind in (("lgbtpu_fleet_replica_polls_total", "counter"),
+                      ("lgbtpu_fleet_replica_swaps_total", "counter"),
+                      ("lgbtpu_fleet_heartbeats_recorded_total", "counter"),
+                      ("lgbtpu_fleet_publish_adopt_lag_ms", "histogram"),
+                      ("lgbtpu_fleet_version_skew", "gauge"),
+                      ("lgbtpu_fleet_applied_version", "gauge"),
+                      ("lgbtpu_fleet_events_log_bytes", "gauge")):
+        assert families.get(fam) == kind, (fam, families.get(fam))
+    assert samples["lgbtpu_fleet_replica_swaps_total"] == 1
+    assert samples["lgbtpu_fleet_heartbeats_recorded_total"] == 2
+    assert samples["lgbtpu_fleet_publish_adopt_lag_ms_count"] == 1
+    # completeness: every snapshot counter/histogram surfaced as a
+    # correctly-typed family (first-family-wins may merge same-name
+    # kin, but nothing may go missing or change type)
+    for name in snap["counters"]:
+        assert families.get(obs._prom_name(name) + "_total") == \
+            "counter", name
+    for name in snap["histograms"]:
+        fam = obs._prom_name(name)
+        assert families.get(fam) == "histogram", name
+        assert samples[fam + "_count"] == \
+            snap["histograms"][name]["count"], name
+
+
 def test_compile_listener_install_is_idempotent():
     import jax
     import jax.numpy as jnp

@@ -101,6 +101,39 @@ def test_mode_gating_and_shared_noop_identity():
     assert t.span("s", domain="serve") is NULL_SPAN
 
 
+def test_trace_id_header_round_trip_and_identity():
+    from lightgbm_tpu.obs_trace import (format_trace_id, parse_trace_id)
+    t = SpanTracer().configure("on")
+    # ids are pid-salted so merged multi-process exports never collide
+    tid = t.new_trace_id()
+    assert (tid >> 40) == (os.getpid() & 0x3FFFFF)
+    # header wire format: decimal string there, int back
+    assert parse_trace_id(format_trace_id(tid)) == tid
+    assert parse_trace_id(None) is None
+    assert parse_trace_id("   ") is None
+    assert parse_trace_id("client-abc") == "client-abc"   # opaque ids pass
+    # current_trace_id reads the innermost open span on THIS thread
+    assert t.current_trace_id() is None
+    with t.span("outer", trace_id=99):
+        assert t.current_trace_id() == 99
+        with t.span("inner"):
+            assert t.current_trace_id() == 99
+    assert t.current_trace_id() is None
+    # process identity lands in the chrome process_name meta (and ONLY
+    # there — the schema gains no new keys)
+    t.set_identity(role="replica", holder="host-1:42")
+    assert t.identity() == {"pid": os.getpid(), "role": "replica",
+                            "holder": "host-1:42"}
+    pname = [m["args"]["name"] for m in t.chrome_trace()["traceEvents"]
+             if m["ph"] == "M" and m["name"] == "process_name"]
+    assert pname == ["lightgbm-tpu [replica host-1:42]"]
+    _assert_chrome_schema(t.chrome_trace())
+    t.set_identity(None, None)
+    pname = [m["args"]["name"] for m in t.chrome_trace()["traceEvents"]
+             if m["ph"] == "M" and m["name"] == "process_name"]
+    assert pname == ["lightgbm-tpu"]
+
+
 def test_new_trace_ids_are_unique_across_threads():
     t = SpanTracer()
     got = []
