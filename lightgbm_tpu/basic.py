@@ -384,12 +384,16 @@ class Booster:
         # refresh learner hyperparameters that affect future trees,
         # PRESERVING the learner class: a Data/Feature/Voting mesh learner
         # must not silently downgrade to SerialTreeLearner mid-training
-        if inner.learner is not None:
-            from .parallel.mesh import _MeshTreeLearner, create_tree_learner
-            mesh = inner.learner.mesh \
-                if isinstance(inner.learner, _MeshTreeLearner) else None
-            inner.learner = create_tree_learner(
-                self.config, inner.train_set, mesh)
+        # under the model lock: serving threads read the learner (the
+        # tpu_forest_kernel resolution rides on it) while we swap it
+        with inner._cache_lock:
+            if inner.learner is not None:
+                from .parallel.mesh import _MeshTreeLearner, \
+                    create_tree_learner
+                mesh = inner.learner.mesh \
+                    if isinstance(inner.learner, _MeshTreeLearner) else None
+                inner.learner = create_tree_learner(
+                    self.config, inner.train_set, mesh)
         # drop cached state derived from the old config (samplers, column
         # masks, fused block functions)
         for attr in ("_sampler_fn", "_fmask_fn"):

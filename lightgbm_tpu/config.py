@@ -233,6 +233,9 @@ class Config:
     #   serve_overload while other tenants keep being admitted)
     serve_tenant_weights: List[str] = field(default_factory=list)
     #   "tenant=weight" fair-share weights (unlisted tenants weigh 1.0)
+    serve_dispatch: str = "continuous"  # continuous (standing dispatch loop,
+    #   new requests join the next in-flight tile) | coalesce (wait up to
+    #   serve_max_wait_ms for company, then launch — the pre-ISSUE-16 loop)
 
     # ---- online training (task=serve + online_train: lightgbm_tpu/online/) ----
     online_train: bool = False        # run an OnlineTrainer per served model
@@ -367,6 +370,15 @@ class Config:
     #   fused kernel is validated on real Mosaic (scripts/split_bisect.py);
     #   on: force where structurally eligible (serial training, planes
     #   family, no feature bundling / CEGB / intermediate monotone).
+    tpu_forest_kernel: str = "auto"  # auto|off|on: forest-at-once serving —
+    #   ONE pallas_call per row tile holding the (tile, trees) traversal
+    #   front in VMEM over BIN-space split-major node tables (ops/forest),
+    #   vs the per-depth-gather predict. Bit-identical scores; the
+    #   per-depth path stays the serving default and the parity oracle.
+    #   auto: off everywhere until the kernel is validated on real Mosaic
+    #   (scripts/forest_bisect.py); on: force where structurally eligible
+    #   (booster trained in-process or with a constructed train_set, node
+    #   tables within the VMEM budget).
     use_quantized_grad: bool = False  # int8 stochastic gradient quantization
     #   (LightGBM 4.x quantized training analog; rows per leaf <= ~16M)
 
@@ -431,6 +443,12 @@ class Config:
         if self.tpu_split_kernel not in ("auto", "off", "on"):
             Log.fatal("tpu_split_kernel must be auto, off or on; got %s",
                       self.tpu_split_kernel)
+        if self.tpu_forest_kernel not in ("auto", "off", "on"):
+            Log.fatal("tpu_forest_kernel must be auto, off or on; got %s",
+                      self.tpu_forest_kernel)
+        if self.serve_dispatch not in ("continuous", "coalesce"):
+            Log.fatal("serve_dispatch must be continuous or coalesce; "
+                      "got %s", self.serve_dispatch)
         if not 0 <= self.serve_port <= 65535:
             Log.fatal("serve_port must be in [0, 65535], got %d",
                       self.serve_port)

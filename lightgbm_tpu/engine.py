@@ -194,7 +194,7 @@ def _ledger_record(booster: Booster) -> None:
         Log.warning("ledger record failed (%s): %s", type(exc).__name__, exc)
 
 
-class CVBooster:
+class CVBooster:  # graftlint: owned -- built and consumed by the cv() caller's thread; never shared with serving workers
     """Ensemble of per-fold boosters (reference: engine.py CVBooster)."""
 
     def __init__(self) -> None:

@@ -1676,7 +1676,8 @@ class SerialTreeLearner:
                          "tpu_hist_kernel": ("pallas", "xla"),
                          "tpu_work_layout": ("planes", "rows"),
                          "tpu_resident_state": ("resident", "off"),
-                         "tpu_split_kernel": ("on", "off")}
+                         "tpu_split_kernel": ("on", "off"),
+                         "tpu_forest_kernel": ("on", "off")}
                 for k, v in raw.items():
                     if k in valid and v in valid[k]:
                         pre[k] = v
@@ -1893,6 +1894,29 @@ class SerialTreeLearner:
                     sk = "off"
                     if auto_sk:
                         sk_why = "structurally ineligible: " + "; ".join(bad)
+            fk = config.tpu_forest_kernel
+            auto_fk = fk == "auto"
+            fk_why = ""
+            if auto_fk and "tpu_forest_kernel" in pre:
+                fk = _pre("tpu_forest_kernel")
+                auto_fk = False
+            elif auto_fk:
+                # auto = off: the forest-at-once serving kernel's bit
+                # parity with the per-depth-gather predict is proven under
+                # the pallas interpreter, but its Mosaic lowering (one
+                # launch per row tile, resident node tables) is
+                # unvalidated on real hardware. The first TPU session runs
+                # scripts/forest_bisect.py and flips the knob — or lets
+                # the run ledger carry the measured answer forward.
+                fk = "off"
+                fk_why = ("forest kernel parity proven under interpret "
+                          "only; Mosaic lowering unmeasured on TPU — run "
+                          "scripts/forest_bisect.py to validate, then "
+                          "enable via knob or ledger")
+            # serve-time eligibility (train_set present, tables within the
+            # VMEM budget) is per-model state — boosting._forest_model
+            # re-checks it on every pack; only the knob resolves here
+            self._forest_kernel = fk
             # auto-knob resolution records: what auto chose and why
             # (deduped, so repeated build_kwargs calls keep one record per
             # distinct resolution)
@@ -1927,6 +1951,8 @@ class SerialTreeLearner:
                      "packed width %d default chunk" % self.bins.shape[1])
             if auto_sk:
                 _rec("tpu_split_kernel", sk, sk_why)
+            if auto_fk:
+                _rec("tpu_forest_kernel", fk, fk_why)
             kw.update(
                 hist_chunk=hist_chunk,
                 part_chunk=part_chunk,

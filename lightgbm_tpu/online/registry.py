@@ -74,6 +74,7 @@ class ModelRegistry:
                  max_queue_rows: int = 0, overload: str = "shed",
                  tenant_quota_rows: int = 0, tenant_weights=None,
                  raw_score: bool = False, warmup: bool = False,
+                 dispatch_mode: str = "continuous", forest=None,
                  online=None) -> RegistryEntry:
         """Build and register the serving stack for one model.
 
@@ -86,7 +87,7 @@ class ModelRegistry:
         model_id = str(model_id)
         if not model_id:
             raise LightGBMError("model_id must be non-empty")
-        session = PredictSession(booster, buckets=buckets)
+        session = PredictSession(booster, buckets=buckets, forest=forest)
         if warmup:
             session.warmup()
         batcher = MicroBatcher(session, max_batch_rows=max_batch_rows,
@@ -94,7 +95,8 @@ class ModelRegistry:
                                max_queue_rows=max_queue_rows,
                                overload=overload,
                                tenant_quota_rows=tenant_quota_rows,
-                               tenant_weights=tenant_weights)
+                               tenant_weights=tenant_weights,
+                               dispatch_mode=dispatch_mode)
         trainer = online
         if isinstance(online, dict):
             trainer = OnlineTrainer(booster, **online)

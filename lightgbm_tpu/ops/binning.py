@@ -12,10 +12,16 @@ uint8/uint16 (rows, features) matrix that lives in device HBM.
 """
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
+
+# guards the lazy sorted-category views: serving threads bin rows for
+# the forest path (session._bin_rows) concurrently with main-thread
+# predicts on the same mappers
+_SORT_LOCK = threading.Lock()
 
 # Values with |x| <= kZeroThreshold fall into the zero bin
 # (reference: include/LightGBM/bin.h:33 kZeroThreshold = 1e-35).
@@ -59,14 +65,17 @@ class BinMapper:
         if self.bin_type == BIN_CATEGORICAL:
             if len(self.categories) == 0:
                 return np.full(values.shape, self.missing_bin, dtype=np.int64)
-            if self._sorted_cats is None:
-                self._sorted_order = np.argsort(self.categories, kind="stable")
-                self._sorted_cats = self.categories[self._sorted_order]
+            with _SORT_LOCK:
+                if self._sorted_cats is None:
+                    self._sorted_order = np.argsort(self.categories,
+                                                    kind="stable")
+                    self._sorted_cats = self.categories[self._sorted_order]
+                scats, sorder = self._sorted_cats, self._sorted_order
             ivals = np.where(np.isfinite(values), values, -1).astype(np.int64)
-            pos = np.searchsorted(self._sorted_cats, ivals)
+            pos = np.searchsorted(scats, ivals)
             pos = np.clip(pos, 0, len(self.categories) - 1)
-            hit = self._sorted_cats[pos] == ivals
-            out = np.where(hit, self._sorted_order[pos], self.missing_bin)
+            hit = scats[pos] == ivals
+            out = np.where(hit, sorder[pos], self.missing_bin)
             return out.astype(np.int64)
         # numerical
         nan_mask = np.isnan(values)

@@ -198,6 +198,50 @@ predict_raw = track_jit("ops/predict_raw", jax.jit(
     static_argnames=("num_class", "has_cat", "has_linear", "tree_batch")))
 
 
+def split_bin_table(a, dataset):
+    """Per-split BIN-space routing quantities for one tree's
+    ``to_split_arrays`` dict: the single conversion shared by
+    ``tree_to_bin_log`` (go_left tables for ``assign_leaves``) and the
+    forest repack (``ops/forest.py`` split-major node tables).
+
+    Returns a dict of per-split arrays — ``feature`` (inner index),
+    ``tbin`` (threshold bin: go left iff ``bin <= tbin``), ``miss_bin``/
+    ``movable`` (missing-bin override), ``valid`` (False where the split
+    feature has no inner index in the dataset) — plus ``cat_bins``
+    mapping categorical split index -> bins routed LEFT."""
+    from .binning import BIN_CATEGORICAL, MISSING_NAN, MISSING_ZERO
+
+    r = len(a["slot"])
+    feature = np.zeros(r, np.int32)
+    tbin = np.zeros(r, np.int32)
+    miss_bin = np.zeros(r, np.int32)
+    movable = np.zeros(r, bool)
+    valid = np.ones(r, bool)
+    cat_bins = {}
+    for i in range(r):
+        inner = dataset.inner_feature_index(int(a["feature"][i]))
+        if inner < 0:
+            valid[i] = False
+            continue
+        m = dataset.bin_mappers[inner]
+        feature[i] = inner
+        if a["kind"][i]:
+            cats = a["cat_values"].get(i, np.array([], np.int64))
+            cat_bins[i] = np.flatnonzero(
+                np.isin(m.categories, cats)).astype(np.int64)
+        else:
+            tb = int(np.searchsorted(m.upper_bounds, float(a["threshold"][i]),
+                                     side="left"))
+            tb = min(tb, m.num_bins - 1)
+            tbin[i] = tb
+            if m.missing_type in (MISSING_ZERO, MISSING_NAN) \
+                    and m.bin_type != BIN_CATEGORICAL:
+                miss_bin[i] = m.missing_bin
+                movable[i] = True
+    return dict(feature=feature, tbin=tbin, miss_bin=miss_bin,
+                movable=movable, valid=valid, cat_bins=cat_bins)
+
+
 def tree_to_bin_log(tree, dataset):
     """Convert a host Tree into a TreeLog-compatible record routing in BIN
     space over the dataset's (bundled) training matrix — lets DART score
@@ -206,7 +250,6 @@ def tree_to_bin_log(tree, dataset):
     (reference analogs: dart.hpp score updates, gbdt.cpp:454
     RollbackOneIter)."""
     from ..learner import TreeLog
-    from .binning import BIN_CATEGORICAL, MISSING_NAN, MISSING_ZERO
 
     a = tree.to_split_arrays()
     r = len(a["slot"])
@@ -217,6 +260,7 @@ def tree_to_bin_log(tree, dataset):
     rp = 16
     while rp < r:
         rp *= 2
+    tbl_r = split_bin_table(a, dataset)
     feature = np.zeros(rp, np.int32)
     tbin = np.zeros(rp, np.int32)
     kind = np.zeros(rp, np.int32)
@@ -224,31 +268,21 @@ def tree_to_bin_log(tree, dataset):
     movable = np.zeros(rp, bool)
     go_left = np.zeros((rp, num_bin), bool)
     b_iota = np.arange(num_bin)
+    feature[:r] = tbl_r["feature"]
+    tbin[:r] = tbl_r["tbin"]
+    miss_bin[:r] = tbl_r["miss_bin"]
+    movable[:r] = tbl_r["movable"]
     for i in range(r):
-        inner = dataset.inner_feature_index(int(a["feature"][i]))
-        if inner < 0:
+        if not tbl_r["valid"][i]:
             continue
-        m = dataset.bin_mappers[inner]
-        feature[i] = inner
         if a["kind"][i]:
             kind[i] = 1
-            cats = a["cat_values"].get(i, np.array([], np.int64))
-            cat_of_bin = np.full(num_bin, -1, np.int64)
-            nc = len(m.categories)
-            cat_of_bin[:nc] = m.categories
-            go_left[i] = np.isin(cat_of_bin, cats)
+            go_left[i, tbl_r["cat_bins"][i]] = True
         else:
-            tb = int(np.searchsorted(m.upper_bounds, float(a["threshold"][i]),
-                                     side="left"))
-            tb = min(tb, m.num_bins - 1)
-            tbin[i] = tb
-            tbl = b_iota <= tb
-            if m.missing_type in (MISSING_ZERO, MISSING_NAN) \
-                    and m.bin_type != BIN_CATEGORICAL:
+            tbl = b_iota <= tbin[i]
+            if movable[i]:
                 tbl = tbl.copy()
-                tbl[m.missing_bin] = bool(a["default_left"][i])
-                miss_bin[i] = m.missing_bin
-                movable[i] = True
+                tbl[miss_bin[i]] = bool(a["default_left"][i])
             go_left[i] = tbl
     slot = np.zeros(rp, np.int32)
     slot[:r] = a["slot"]

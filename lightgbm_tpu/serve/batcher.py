@@ -1,12 +1,27 @@
-"""Micro-batching request coalescer with per-tenant fair queuing.
+"""Micro-batching request dispatcher with per-tenant fair queuing.
 
-A single background worker drains the pending queues, coalescing
-concurrent ``submit(X)`` calls into ONE bucketed device dispatch per
-batch — ensemble inference throughput is won by amortizing launches over
-large coalesced batches, so at batch size 1 the dominant cost is
-dispatch, not math. Two knobs bound the trade: ``max_batch_rows`` caps
-how much a batch grows, ``max_wait_ms`` caps how long the first request
-in a batch waits for company.
+A background worker drains the pending queues, batching concurrent
+``submit(X)`` calls into bucketed device dispatches — ensemble
+inference throughput is won by amortizing launches over large batches,
+so at batch size 1 the dominant cost is dispatch, not math. Two
+dispatch disciplines:
+
+- ``continuous`` (default): a standing dispatch loop. The worker seals
+  a tile from whatever is queued RIGHT NOW and launches it
+  asynchronously; a separate deliver thread performs the one
+  device->host sync and resolves futures. While one tile's sync is in
+  flight, newly-submitted requests accumulate and are admitted into the
+  next tile — batching emerges from device-side backpressure (a bounded
+  in-flight window) instead of from a wall-clock company wait, so an
+  idle server answers a lone request immediately instead of parking it
+  for ``max_wait_ms``.
+- ``coalesce``: the classic single-thread discipline — the first
+  request of a batch waits up to ``max_wait_ms`` for company, then the
+  batch is dispatched and delivered inline before the next is formed.
+
+Two knobs bound batch growth in both modes: ``max_batch_rows`` caps how
+much a tile grows; ``max_wait_ms`` caps the company wait (coalesce
+only — continuous never waits for company).
 
 Results come back through ``concurrent.futures.Future``; a worker
 exception fails every future of its batch (callers see the real error,
@@ -53,7 +68,15 @@ from ..obs_trace import tracer
 
 OVERLOAD_POLICIES = ("shed", "block")
 
+DISPATCH_MODES = ("continuous", "coalesce")
+
 DEFAULT_TENANT = "default"
+
+# continuous mode: how many dispatched-but-undelivered tiles may be in
+# flight before the dispatch loop blocks. Depth 2 overlaps the next
+# tile's launch with the current tile's host sync without letting an
+# unbounded pipeline hide queue growth from admission control.
+_INFLIGHT_DEPTH = 2
 
 
 class QueueFullError(RuntimeError):
@@ -99,14 +122,18 @@ class MicroBatcher:
     coalesced dispatch must share the output transform).
     ``tenant_weights`` maps tenant id -> relative fair-share weight
     (unlisted tenants weigh 1.0); ``tenant_quota_rows`` caps any single
-    tenant's queued rows (0 = no per-tenant cap).
+    tenant's queued rows (0 = no per-tenant cap). ``dispatch_mode``
+    picks the discipline: ``continuous`` (standing dispatch loop +
+    deliver thread, no company wait) or ``coalesce`` (single thread,
+    first request waits up to ``max_wait_ms`` for company).
     """
 
     def __init__(self, session, *, max_batch_rows: int = 8192,
                  max_wait_ms: float = 2.0, raw_score: bool = False,
                  latency_window: int = 2048, max_queue_rows: int = 0,
                  overload: str = "shed", tenant_quota_rows: int = 0,
-                 tenant_weights: Optional[Dict[str, float]] = None) -> None:
+                 tenant_weights: Optional[Dict[str, float]] = None,
+                 dispatch_mode: str = "continuous") -> None:
         if max_batch_rows < 1:
             raise ValueError("max_batch_rows must be >= 1")
         if max_wait_ms < 0:
@@ -119,6 +146,9 @@ class MicroBatcher:
         if overload not in OVERLOAD_POLICIES:
             raise ValueError("overload must be one of %s, got %r"
                              % ("|".join(OVERLOAD_POLICIES), overload))
+        if dispatch_mode not in DISPATCH_MODES:
+            raise ValueError("dispatch_mode must be one of %s, got %r"
+                             % ("|".join(DISPATCH_MODES), dispatch_mode))
         weights = dict(tenant_weights or {})
         for t, w in weights.items():
             if not w > 0:
@@ -154,9 +184,24 @@ class MicroBatcher:
         del latency_window
         self._hist = obs.Histogram()
         self._closed = False
+        self.dispatch_mode = dispatch_mode
+        self._continuous = dispatch_mode == "continuous"
+        # continuous-mode in-flight window: the dispatch loop appends
+        # (batch, pieces) after launching, the deliver thread pops and
+        # performs the host sync. Its own Condition so delivery never
+        # contends with submit/fair-queuing on the main lock.
+        self._dcond = threading.Condition()
+        self._inflight: deque = deque()   # graftlint: guarded-by=_dcond
+        self._prod_done = False           # graftlint: guarded-by=_dcond
         self._thread = threading.Thread(
             target=self._worker, name="lgbtpu-serve-batcher", daemon=True)
         self._thread.start()
+        self._deliver_thread: Optional[threading.Thread] = None
+        if self._continuous:
+            self._deliver_thread = threading.Thread(
+                target=self._deliverer, name="lgbtpu-serve-deliver",
+                daemon=True)
+            self._deliver_thread.start()
 
     # ---------------------------------------------------------------- tenants
     def _tenant(self, tenant: str) -> _TenantState:
@@ -309,6 +354,20 @@ class MicroBatcher:
         return req
 
     def _worker(self) -> None:
+        try:
+            if self._continuous:
+                self._worker_continuous()
+            else:
+                self._worker_coalesce()
+        finally:
+            if self._continuous:
+                # no more tiles will be launched; let the deliver thread
+                # drain the in-flight window and exit
+                with self._dcond:
+                    self._prod_done = True   # graftlint: guarded-by=_dcond
+                    self._dcond.notify_all()
+
+    def _worker_coalesce(self) -> None:
         while True:
             with self._lock:
                 while self._queued_requests == 0 and not self._closed:
@@ -339,23 +398,85 @@ class MicroBatcher:
                     break
                 batch.append(nxt)
                 rows += nxt.rows
-            with self._lock:
-                depth = self._queued_requests
-            telemetry.gauge("serve/queue_depth", depth)
-            if tracer.serve_on:
-                # retroactive spans: each request's time-in-queue (submit
-                # until its batch was sealed) plus one coalesce span for
-                # the assembly window itself
-                now = obs.monotonic()
-                for r in batch:
-                    tracer.record("serve/queue_wait", r.t0, now,
-                                  trace_id=r.trace_id)
-                tracer.record("serve/coalesce", t_first, now,
-                              trace_id=batch[0].trace_id,
-                              args={"requests": len(batch), "rows": rows})
-            self._run_batch(batch)
+            self._seal_batch(batch, t_first, rows)
+            pieces = self._launch(batch)
+            if pieces is not None:
+                self._deliver(batch, pieces)
 
-    def _run_batch(self, batch) -> None:
+    def _worker_continuous(self) -> None:
+        # the standing dispatch loop: seal a tile from whatever is
+        # queued right now and launch it — never wait for company. While
+        # the deliver thread syncs an in-flight tile, new submissions
+        # accumulate and ride the NEXT tile; under load the bounded
+        # in-flight window is what grows batches, not a wall-clock wait.
+        while True:
+            with self._lock:
+                while self._queued_requests == 0 and not self._closed:
+                    self._lock.wait()
+                if self._closed:
+                    self._drain_locked()
+                    return
+                req = self._pick_locked()
+            batch = [req]
+            rows = req.rows
+            t_first = obs.monotonic()
+            while rows < self._max_rows:
+                with self._lock:
+                    nxt = self._pick_locked()
+                if nxt is None:
+                    break
+                batch.append(nxt)
+                rows += nxt.rows
+            self._seal_batch(batch, t_first, rows)
+            pieces = self._launch(batch)
+            if pieces is None:
+                continue
+            with self._dcond:
+                # bounded in-flight window: block the dispatch loop when
+                # the deliver thread falls behind, so queue depth (the
+                # admission-control quantity) reflects real backlog
+                while len(self._inflight) >= _INFLIGHT_DEPTH:  # graftlint: guarded-by=_dcond
+                    self._dcond.wait()
+                self._inflight.append((batch, pieces))  # graftlint: guarded-by=_dcond
+                self._dcond.notify_all()
+
+    def _deliverer(self) -> None:
+        # continuous mode's delivery side: pop in-flight tiles in launch
+        # order, host-sync, finalize, resolve futures
+        while True:
+            with self._dcond:
+                while not self._inflight and not self._prod_done:  # graftlint: guarded-by=_dcond
+                    self._dcond.wait()
+                if not self._inflight:   # graftlint: guarded-by=_dcond
+                    return
+                batch, pieces = self._inflight.popleft()  # graftlint: guarded-by=_dcond
+                self._dcond.notify_all()
+            self._deliver(batch, pieces)
+
+    def _seal_batch(self, batch, t_first: float, rows: int) -> None:
+        """Account for one sealed batch: queue-wait histogram (submit
+        until its batch was sealed — the knob continuous batching exists
+        to shrink), queue-depth gauge, and retroactive trace spans."""
+        with self._lock:
+            depth = self._queued_requests
+        telemetry.gauge("serve/queue_depth", depth)
+        now = obs.monotonic()
+        for r in batch:
+            telemetry.observe("serve/queue_wait_ms", (now - r.t0) * 1000.0)
+        if tracer.serve_on:
+            # retroactive spans: each request's time-in-queue plus one
+            # coalesce span for the assembly window itself
+            for r in batch:
+                tracer.record("serve/queue_wait", r.t0, now,
+                              trace_id=r.trace_id)
+            tracer.record("serve/coalesce", t_first, now,
+                          trace_id=batch[0].trace_id,
+                          args={"requests": len(batch), "rows": rows})
+
+    def _launch(self, batch):
+        """Concatenate + dispatch one sealed batch on the device (async —
+        no host sync here). Returns the dispatched pieces, or None after
+        failing the batch's futures on a dispatch error."""
         n_rows = sum(r.rows for r in batch)
         telemetry.count("serve/batches")
         telemetry.count("serve/batch_rows", n_rows)
@@ -368,13 +489,25 @@ class MicroBatcher:
                     np.concatenate([r.X for r in batch], axis=0)
                 with obs.wall("serve/batch"):
                     pieces = self._session.dispatch(X)
-                    # the serve path's one sanctioned device->host sync:
-                    # pull the coalesced scores for result delivery
-                    with tracer.span("serve/slice_back", domain="serve"):
-                        host = [np.asarray(s, np.float64)[:r]  # graftlint: disable=host-sync
-                                for s, r in pieces]
-                raw = host[0] if len(host) == 1 else np.concatenate(host)
-                out = self._session.finalize(raw, raw_score=self._raw)
+        except BaseException as exc:
+            for r in batch:
+                if not r.future.done():
+                    r.future.set_exception(exc)
+            return None
+        return pieces
+
+    def _deliver(self, batch, pieces) -> None:
+        """Host-sync one launched batch, finalize, resolve its futures.
+        Runs on the deliver thread (continuous) or inline (coalesce)."""
+        try:
+            # the serve path's one sanctioned device->host sync: pull
+            # the coalesced scores for result delivery
+            with tracer.span("serve/slice_back", domain="serve",
+                             trace_id=batch[0].trace_id):
+                host = [np.asarray(s, np.float64)[:r]  # graftlint: disable=host-sync
+                        for s, r in pieces]
+            raw = host[0] if len(host) == 1 else np.concatenate(host)
+            out = self._session.finalize(raw, raw_score=self._raw)
         except BaseException as exc:
             for r in batch:
                 if not r.future.done():
@@ -427,19 +560,24 @@ class MicroBatcher:
                 req.future.set_exception(RuntimeError("MicroBatcher closed"))
 
     def close(self, timeout: Optional[float] = None) -> None:
-        """Stop accepting requests, finish the in-flight batch, fail any
-        still-queued futures, join the worker. Idempotent. The flag flips
-        under the submit lock, so every request that beat the flip is
-        either dispatched with the in-flight batch or failed
-        deterministically by the worker's drain; block-policy submitters
-        parked for queue space are woken and raise instead of hanging on
-        a dead worker."""
+        """Stop accepting requests, finish + deliver every in-flight
+        batch, fail any still-queued futures, join the worker(s).
+        Idempotent. The flag flips under the submit lock, so every
+        request that beat the flip is either dispatched with an
+        in-flight batch or failed deterministically by the worker's
+        drain; block-policy submitters parked for queue space are woken
+        and raise instead of hanging on a dead worker. In continuous
+        mode the dispatch loop exits first (marking the in-flight window
+        done), then the deliver thread drains launched tiles to their
+        futures and exits — graceful drain, no dropped answers."""
         with self._lock:
             if self._closed:
                 return
             self._closed = True
             self._lock.notify_all()
         self._thread.join(timeout)
+        if self._deliver_thread is not None:
+            self._deliver_thread.join(timeout)
 
     def __enter__(self) -> "MicroBatcher":
         return self

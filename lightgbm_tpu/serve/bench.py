@@ -56,6 +56,8 @@ def run_serve_bench(*, requests: int = 512, rows_per_request: int = 1,
                     max_batch_rows: int = 8192, max_wait_ms: float = 2.0,
                     closed_loop_requests: int = 128,
                     assert_speedup: Optional[float] = None,
+                    dispatch_mode: str = "continuous",
+                    binned: bool = False,
                     seed: int = 3) -> Dict[str, Any]:
     """Train a small model, replay a request stream three ways, return a
     bench-style JSON-serializable dict. With ``assert_speedup``, raises
@@ -84,7 +86,8 @@ def run_serve_bench(*, requests: int = 512, rows_per_request: int = 1,
     session.warmup([rows_per_request, min(max_batch_rows, len(pool))])
     served = []
     with lgb.serve.MicroBatcher(session, max_batch_rows=max_batch_rows,
-                                max_wait_ms=max_wait_ms) as mb:
+                                max_wait_ms=max_wait_ms,
+                                dispatch_mode=dispatch_mode) as mb:
         with obs.wall("serve_bench/open_loop") as w:
             futs = [mb.submit(r) for r in reqs]
             served = [f.result(timeout=120) for f in futs]
@@ -109,9 +112,10 @@ def run_serve_bench(*, requests: int = 512, rows_per_request: int = 1,
         "metric": "serve_open_loop_throughput",
         "value": round(total_rows / open_s, 2),
         "unit": "rows/s (%d requests x %d rows, %d trees x %d leaves, "
-                "max_batch_rows=%d max_wait_ms=%g)"
+                "max_batch_rows=%d max_wait_ms=%g dispatch=%s)"
                 % (requests, rows_per_request, trees, num_leaves,
-                   max_batch_rows, max_wait_ms),
+                   max_batch_rows, max_wait_ms, dispatch_mode),
+        "dispatch_mode": dispatch_mode,
         "vs_baseline": round(speedup, 3),
         "naive_rows_per_s": round(total_rows / naive_s, 2),
         "naive_s": round(naive_s, 4),
@@ -126,11 +130,32 @@ def run_serve_bench(*, requests: int = 512, rows_per_request: int = 1,
         # the batcher's own submit->delivery histogram (open + closed
         # loop requests), as served by /metrics
         "serve_latency_hist": telemetry.histogram("serve/latency_ms"),
+        # time-in-queue until batch seal — the quantity continuous
+        # dispatch exists to shrink
+        "queue_wait_hist": telemetry.histogram("serve/queue_wait_ms"),
         "parity_max_abs_err": parity,
         "serve_counters": {
             k: v for k, v in telemetry.snapshot()["counters"].items()
             if k.startswith("serve/")},
     }
+    if binned:
+        # pre-binned fast path: the caller already holds a constructed
+        # Dataset sharing the training bin mappers, so serving can route
+        # in BIN space (no raw-threshold comparisons). Parity against
+        # the naive per-request answers is asserted in-run.
+        pool_ds = lgb.Dataset(pool, reference=ds,
+                              free_raw_data=False).construct()
+        binned_pred = session.predict_binned(pool_ds)  # warm bin-log cache
+        with obs.wall("serve_bench/binned") as w:
+            binned_pred = session.predict_binned(pool_ds)
+        binned_s = max(w.seconds, 1e-9)
+        np.testing.assert_allclose(np.atleast_1d(binned_pred), flat_naive,
+                                   rtol=1e-4, atol=1e-5)
+        result["binned_rows_per_s"] = round(total_rows / binned_s, 2)
+        result["binned_s"] = round(binned_s, 4)
+        result["binned_parity_max_abs_err"] = float(
+            np.max(np.abs(np.atleast_1d(binned_pred) - flat_naive))) \
+            if len(flat_naive) else 0.0
     if assert_speedup is not None and speedup < assert_speedup:
         raise AssertionError(
             "serve speedup %.2fx below the required %.1fx (naive %.3fs, "

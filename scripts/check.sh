@@ -2,9 +2,10 @@
 # Pre-commit gate, layered by cost:
 #
 #   check.sh            lint (full repo) + lint tests + the fast
-#                       serve/online/obs/one-kernel tier-1 subset
-#                       (a few min CPU; the one-kernel parity trains
-#                       run under the pallas interpreter)
+#                       serve/online/obs/one-kernel/forest-kernel
+#                       tier-1 subset (a few min CPU; the one-kernel
+#                       and forest-kernel parity trains run under the
+#                       pallas interpreter)
 #   check.sh --fast     lint only files changed vs git + lint tests
 #   check.sh --fleet    lint + lint tests + the fleet/online/serve fast
 #                       subset (durability/fairness/rollback plus the
@@ -38,11 +39,12 @@ echo "== lint tests =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_lint.py -q -m 'not slow'
 
 if [ "$RUN_SUBSET" = 1 ]; then
-    echo "== serve/online/obs/linear/one-kernel fast tests =="
+    echo "== serve/online/obs/linear/one-kernel/forest fast tests =="
     JAX_PLATFORMS=cpu python -m pytest -q -m 'not slow' \
         tests/test_serve.py tests/test_online.py \
         tests/test_obs.py tests/test_trace.py \
-        tests/test_linear_device.py tests/test_one_kernel.py
+        tests/test_linear_device.py tests/test_one_kernel.py \
+        tests/test_forest_kernel.py
 fi
 
 if [ "$RUN_FLEET" = 1 ]; then

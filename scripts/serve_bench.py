@@ -35,6 +35,14 @@ def main(argv=None) -> int:
     ap.add_argument("--features", type=int, default=None)
     ap.add_argument("--max-batch-rows", type=int, default=8192)
     ap.add_argument("--max-wait-ms", type=float, default=2.0)
+    ap.add_argument("--dispatch-mode", default="continuous",
+                    choices=("continuous", "coalesce"),
+                    help="batcher discipline: continuous (standing "
+                         "dispatch loop) or coalesce (company wait)")
+    ap.add_argument("--binned", action="store_true",
+                    help="also time the pre-binned predict_binned fast "
+                         "path over a constructed Dataset (parity "
+                         "asserted in-run)")
     ap.add_argument("--no-assert", action="store_true",
                     help="report the speedup without gating on >=5x")
     ap.add_argument("--trace", default=None, metavar="PATH",
@@ -66,6 +74,8 @@ def main(argv=None) -> int:
             rows_per_request=args.rows_per_request,
             max_batch_rows=args.max_batch_rows,
             max_wait_ms=args.max_wait_ms,
+            dispatch_mode=args.dispatch_mode,
+            binned=args.binned,
             assert_speedup=None if args.no_assert else 5.0,
             **preset)
     except AssertionError as exc:

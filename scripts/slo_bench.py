@@ -39,9 +39,18 @@ With ``--online`` a labeled-ingestion thread feeds POST /ingest while the
 clients run, so the reported p99 INCLUDES background train cycles and
 promotion swaps — the number the PERF.md promotion-cost note quotes.
 
+``--ab-dispatch`` is the dispatch-discipline A/B: four interleaved
+closed-loop windows (ABBA order: continuous, coalesce, coalesce,
+continuous), each with a fresh server and fresh telemetry, so machine
+drift cannot masquerade as a dispatch-mode effect. The gate fails
+unless continuous dispatch materially reduces pooled queue-wait p99
+versus coalesce.
+
 Prints ONE JSON line (bench.py style): p50/p90/p99/p999 from the
-``serve/latency_ms`` histogram, throughput, shed/error counts, and the
-online promotion counters. Gates (exit 1 on miss): ``--p99-target-ms``
+``serve/latency_ms`` histogram, the same percentiles from
+``serve/queue_wait_ms`` (time from submit until batch seal — the
+quantity continuous dispatch shrinks), throughput, shed/error counts,
+and the online promotion counters. Gates (exit 1 on miss): ``--p99-target-ms``
 absolute, or ``--against BASELINE.json`` relative (p99 within
 ``--tolerance``x of the recorded baseline). ``--baseline PATH`` records
 the run for future ``--against`` gates.
@@ -513,6 +522,104 @@ def _run_noisy_tenant(args) -> int:
     return 0 if result["pass"] else 1
 
 
+def _run_ab_dispatch(args) -> int:
+    """Interleaved A/B: continuous vs coalesce dispatch over alternating
+    closed-loop windows (ABBA), a fresh server + fresh telemetry per
+    window — machine drift lands on both arms, so a queue-wait gap is a
+    dispatch-mode effect. Gate: pooled continuous queue-wait p99 must be
+    materially below coalesce's (``--ab-factor``)."""
+    import numpy as np
+
+    from lightgbm_tpu import obs
+    from lightgbm_tpu.obs import telemetry
+    from lightgbm_tpu.serve import PredictServer
+
+    preset = _preset(args)
+    clients = args.clients or preset["clients"]
+    per_window = max(clients, (args.requests or preset["requests"]) // 4)
+    rows = args.rows_per_request
+    bst, rng, _ = _train_seed(preset)
+    payload = json.dumps(
+        {"rows": rng.randn(rows, preset["features"]).tolist()}).encode()
+
+    order = ["continuous", "coalesce", "coalesce", "continuous"]
+    windows = []
+    fails_all = []
+    for wi, mode in enumerate(order):
+        telemetry.reset()
+        server = PredictServer(bst, port=0, buckets=(64, 256), warmup=True,
+                               max_wait_ms=args.ab_wait_ms,
+                               dispatch_mode=mode)
+        host, port = server.address
+        base = "http://%s:%d" % (host, port)
+        th = threading.Thread(target=server.serve_forever,
+                              name="slo-ab-serve%d" % wi, daemon=True)
+        th.start()
+        fails, sheds = [], []
+        threads = [threading.Thread(
+            target=_client, name="slo-ab-w%d-c%d" % (wi, i),
+            args=(base, per_window // clients, rows, payload, fails, sheds))
+            for i in range(clients)]
+        t0 = obs.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = obs.monotonic() - t0
+        server.shutdown()
+        th.join(timeout=30)
+        server.close()
+        fails_all.extend(fails)
+        lat = telemetry.histogram("serve/latency_ms") or {}
+        qw = telemetry.histogram("serve/queue_wait_ms") or {}
+        windows.append({
+            "window": wi, "dispatch_mode": mode,
+            "elapsed_s": round(elapsed, 3),
+            "latency_p99_ms": lat.get("p99"),
+            "queue_wait_p50_ms": qw.get("p50"),
+            "queue_wait_p99_ms": qw.get("p99"),
+        })
+
+    def pooled(mode, key):
+        vals = [w[key] for w in windows
+                if w["dispatch_mode"] == mode and w[key] is not None]
+        return float(np.max(vals)) if vals else 0.0
+
+    cont_qw = pooled("continuous", "queue_wait_p99_ms")
+    coal_qw = pooled("coalesce", "queue_wait_p99_ms")
+    result = {
+        "bench": "slo_ab_dispatch",
+        "quick": bool(args.quick),
+        "clients": clients,
+        "requests_per_window": per_window,
+        "rows_per_request": rows,
+        "max_wait_ms": args.ab_wait_ms,
+        "windows": windows,
+        "continuous_queue_wait_p99_ms": round(cont_qw, 3),
+        "coalesce_queue_wait_p99_ms": round(coal_qw, 3),
+        "continuous_latency_p99_ms": round(
+            pooled("continuous", "latency_p99_ms"), 3),
+        "coalesce_latency_p99_ms": round(
+            pooled("coalesce", "latency_p99_ms"), 3),
+        "ab_factor": args.ab_factor,
+        "errors": fails_all[:5],
+    }
+    gate_msgs = []
+    if fails_all:
+        gate_msgs.append("%d request failures" % len(fails_all))
+    if coal_qw <= 0:
+        gate_msgs.append("coalesce arm recorded no queue wait")
+    elif cont_qw > coal_qw * args.ab_factor:
+        gate_msgs.append(
+            "continuous queue-wait p99 %.3fms > %.2fx coalesce %.3fms"
+            % (cont_qw, args.ab_factor, coal_qw))
+    result["pass"] = not gate_msgs
+    if gate_msgs:
+        result["gate_failures"] = gate_msgs
+    print(json.dumps(result))
+    return 0 if result["pass"] else 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="slo_bench", description=__doc__,
@@ -540,6 +647,19 @@ def main(argv=None) -> int:
     ap.add_argument("--fair-p99-factor", type=float, default=8.0,
                     help="--noisy-tenant bound: contended polite p99 must "
                          "stay within this factor of its solo p99")
+    ap.add_argument("--dispatch-mode", default="continuous",
+                    choices=("continuous", "coalesce"),
+                    help="batcher discipline for the serving stack")
+    ap.add_argument("--ab-dispatch", action="store_true",
+                    help="interleaved A/B: continuous vs coalesce "
+                         "dispatch over alternating closed-loop windows; "
+                         "gates on queue-wait p99 reduction")
+    ap.add_argument("--ab-wait-ms", type=float, default=5.0,
+                    help="--ab-dispatch max_wait_ms for both arms (the "
+                         "coalesce company-wait the A/B exposes)")
+    ap.add_argument("--ab-factor", type=float, default=0.67,
+                    help="--ab-dispatch gate: continuous queue-wait p99 "
+                         "must be <= this fraction of coalesce's")
     ap.add_argument("--max-queue-rows", type=int, default=0)
     ap.add_argument("--p99-target-ms", type=float, default=None,
                     help="absolute gate: exit 1 when p99 exceeds this")
@@ -559,6 +679,8 @@ def main(argv=None) -> int:
         return _run_failover(args)
     if args.noisy_tenant:
         return _run_noisy_tenant(args)
+    if args.ab_dispatch:
+        return _run_ab_dispatch(args)
 
     import numpy as np
 
@@ -577,6 +699,7 @@ def main(argv=None) -> int:
     server = PredictServer(bst, port=0, buckets=(64, 256), warmup=True,
                            max_wait_ms=2.0,
                            max_queue_rows=args.max_queue_rows,
+                           dispatch_mode=args.dispatch_mode,
                            online=online)
     host, port = server.address
     base = "http://%s:%d" % (host, port)
@@ -645,6 +768,7 @@ def main(argv=None) -> int:
         online_state = trainer.state()
 
     hist = telemetry.histogram("serve/latency_ms") or {}
+    qwait = telemetry.histogram("serve/queue_wait_ms") or {}
     swap = telemetry.histogram("online/promote_swap_ms")
     served = telemetry.counter("serve/requests") - req0
     result = {
@@ -653,10 +777,13 @@ def main(argv=None) -> int:
         "clients": clients,
         "requests": served,
         "rows_per_request": rows,
+        "dispatch_mode": args.dispatch_mode,
         "elapsed_s": round(elapsed, 3),
         "rows_per_s": round(served * rows / max(elapsed, 1e-9), 1),
         "latency_ms": {k: hist.get(k) for k in ("p50", "p90", "p99",
                                                 "p999")},
+        "queue_wait_ms": {k: qwait.get(k) for k in ("p50", "p90", "p99",
+                                                    "p999")},
         "shed": telemetry.counter("serve/shed") - shed0,
         "client_429": len(sheds),
         "errors": fails[:5],

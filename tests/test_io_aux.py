@@ -117,6 +117,7 @@ def test_native_parser_matches_python(tmp_path, rng):
     np.testing.assert_allclose(M, np.genfromtxt(p, delimiter="\t"))
 
 
+@pytest.mark.slow  # two full trainings; accuracy comparison, not a parity pin
 def test_quantized_gradients_accuracy(rng):
     """int8 quantized-gradient histograms (LightGBM 4.x quantized training
     analog) must track the exact path's accuracy."""

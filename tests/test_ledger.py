@@ -113,6 +113,8 @@ def test_second_train_preresolves_all_tpu_auto_knobs(tmp_path):
     assert entries[-1]["resolved_knobs"] == first
 
 
+@pytest.mark.slow  # two fresh-resolution trainings; the preresolve hit
+# path itself stays tier-1 (test_second_train_preresolves_all_tpu_auto_knobs)
 def test_preresolve_ignores_mismatched_key(tmp_path):
     """Different shape or different config fingerprint: no preresolution,
     knobs resolve fresh."""
@@ -230,6 +232,7 @@ def test_cli_list_show_gate(tmp_path):
     assert ledger_cli.main(["gate", "--path", path]) == 0
 
 
+@pytest.mark.slow  # subprocess gate (check.sh --ledger pair), per the marker's charter
 def test_cli_train_then_gate(tmp_path):
     """The check.sh --ledger pair end-to-end: train appends a gated
     entry, gate compares (first run: pass on no prior)."""

@@ -49,6 +49,7 @@ def test_linear_tree_nan_fallback(rng):
     assert np.isfinite(pred).all()
 
 
+@pytest.mark.slow  # two full trainings; behavioral comparison, not a parity pin
 def test_cegb_coupled_penalty_shrinks_feature_set(rng):
     n, f = 2500, 12
     X = rng.randn(n, f)
@@ -64,6 +65,7 @@ def test_cegb_coupled_penalty_shrinks_feature_set(rng):
     assert used_cegb < f
 
 
+@pytest.mark.slow  # two full trainings; behavioral comparison, not a parity pin
 def test_cegb_split_penalty_shrinks_trees(rng):
     n = 2500
     X = rng.randn(n, 6)

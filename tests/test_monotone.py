@@ -42,7 +42,13 @@ def _worst_slope(bst, feature, sign, reps=25, seed=3):
     return worst
 
 
-@pytest.mark.parametrize("method", ["basic", "intermediate", "advanced"])
+# tier-1 keeps one soundness train (basic); the heavier methods ride the
+# full run — each is a ~2 min multi-tree training on this one-core host
+@pytest.mark.parametrize("method", [
+    "basic",
+    pytest.param("intermediate", marks=pytest.mark.slow),
+    pytest.param("advanced", marks=pytest.mark.slow),
+])
 def test_monotone_soundness(method):
     X, y = _data()
     bst = _train(X, y, method)
@@ -51,6 +57,7 @@ def test_monotone_soundness(method):
     assert _worst_slope(bst, 1, -1) >= -1e-7
 
 
+@pytest.mark.slow  # three full trainings; quality comparison, not a parity pin
 def test_method_quality_ordering():
     X, y = _data()
     l2 = {}
@@ -73,6 +80,7 @@ def test_advanced_enabled_no_downgrade():
     assert hp.mono_advanced and hp.has_monotone
 
 
+@pytest.mark.slow  # two full trainings; quality comparison, not a parity pin
 def test_advanced_beats_intermediate_on_restricted_neighbor():
     """The reference's motivating case for advanced constraints
     (monotone_constraints.hpp:856): a neighbor's bound applies only to part

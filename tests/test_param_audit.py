@@ -94,6 +94,7 @@ def test_noop_params_warn(capsys):
     assert any("force_row_wise" in m for m in msgs)
 
 
+@pytest.mark.slow  # two full trainings; knob-sensitivity audit, not a parity pin
 def test_monotone_penalty_changes_model():
     rng = np.random.RandomState(0)
     X = rng.normal(size=(2000, 4))
@@ -127,6 +128,7 @@ def test_pred_early_stop_binary():
     assert not np.allclose(es, full)
 
 
+@pytest.mark.slow  # two full trainings; knob-sensitivity audit, not a parity pin
 def test_extra_seed_changes_extra_trees():
     rng = np.random.RandomState(2)
     X = rng.normal(size=(1500, 6))

@@ -33,6 +33,7 @@ def _logloss(y, p):
     return float(-np.mean(y * np.log(p) + (1 - y) * np.log(1 - p)))
 
 
+@pytest.mark.slow  # real-dataset accuracy anchor (~4 min train), not a parity pin
 def test_binary_breast_cancer_anchor():
     sklearn = pytest.importorskip("sklearn")
     from sklearn.datasets import load_breast_cancer

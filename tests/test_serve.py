@@ -169,6 +169,119 @@ def test_batcher_coalesces_into_few_batches():
     np.testing.assert_array_equal(np.concatenate(outs), sess.predict(X[:40]))
 
 
+class _InstantSession:
+    """Dispatch-free fake: batcher-discipline tests must not depend on
+    model math or compile time."""
+
+    buckets = (64,)
+
+    def __init__(self, delay=0.0):
+        self.delay = delay
+
+    def dispatch(self, X):
+        if self.delay:
+            import time as _time
+            _time.sleep(self.delay)
+        return [(np.asarray(X).sum(axis=1), len(X))]
+
+    def finalize(self, raw, raw_score=False):
+        return np.asarray(raw)
+
+
+def test_dispatch_mode_validated():
+    with pytest.raises(ValueError):
+        MicroBatcher(_InstantSession(), dispatch_mode="sideways")
+
+
+def test_continuous_dispatch_cuts_queue_wait():
+    """ISSUE 16 tentpole B: coalesce parks a lone request for the full
+    max_wait_ms company window; continuous dispatches it immediately.
+    Same requests, same session — queue wait (and end-to-end latency)
+    must collapse, and the serve/queue_wait_ms histogram must record it
+    in both modes."""
+    import time as _time
+
+    waits, qw50 = {}, {}
+    for mode in ("coalesce", "continuous"):
+        obs.telemetry.reset()
+        with MicroBatcher(_InstantSession(), max_wait_ms=200.0,
+                          dispatch_mode=mode) as mb:
+            t0 = _time.monotonic()
+            for _ in range(3):
+                np.testing.assert_allclose(
+                    mb.submit(np.ones((2, 4))).result(timeout=60), 4.0)
+            waits[mode] = _time.monotonic() - t0
+        h = obs.telemetry.histogram("serve/queue_wait_ms")
+        assert h is not None and h["count"] == 3, h
+        qw50[mode] = h["p50"]
+    assert waits["coalesce"] > 0.45, \
+        "coalesce should pay ~3x200ms company wait, took %.3fs" \
+        % waits["coalesce"]
+    assert waits["continuous"] < waits["coalesce"] / 3, waits
+    assert qw50["continuous"] < qw50["coalesce"] / 3, qw50
+
+
+def test_continuous_close_delivers_launched_tile():
+    """Graceful drain: a tile already launched when close() lands is
+    DELIVERED (its futures resolve with results), and both serving
+    threads are joined."""
+    import time as _time
+
+    mb = MicroBatcher(_InstantSession(delay=0.2),
+                      dispatch_mode="continuous")
+    fut = mb.submit(np.ones((4, 4)))
+    _time.sleep(0.05)                    # worker picked it; dispatch busy
+    mb.close(timeout=30)
+    np.testing.assert_allclose(fut.result(timeout=1), 4.0)
+    assert not mb._thread.is_alive()
+    assert not mb._deliver_thread.is_alive()
+
+
+def test_continuous_batcher_bit_identical_to_session():
+    """The continuous discipline changes WHEN tiles seal, never what a
+    row scores: concurrent single-row submits equal the sealed-bucket
+    session answer bit for bit (same contract as the coalesce test
+    above, which now runs both modes via the default)."""
+    X, y = _data(n=600, seed=7)
+    bst, _ = _train(X, y)
+    sess = PredictSession(bst, buckets=(64, 256))
+    base = sess.predict(X[:64])
+    results = {}
+    with MicroBatcher(sess, max_batch_rows=64,
+                      dispatch_mode="continuous") as mb:
+        def post(i):
+            results[i] = mb.submit(X[i:i + 1]).result(timeout=60)
+        threads = [threading.Thread(target=post, args=(i,))
+                   for i in range(64)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    got = np.concatenate([results[i] for i in range(64)])
+    np.testing.assert_array_equal(got, base)
+
+
+def test_continuous_shed_and_block_admission_preserved():
+    """Admission control is mode-independent: a full queue sheds under
+    continuous dispatch exactly as it did under coalesce."""
+    from lightgbm_tpu.serve.batcher import QueueFullError
+
+    mb = MicroBatcher(_InstantSession(delay=0.2), max_batch_rows=8,
+                      max_queue_rows=8, overload="shed",
+                      dispatch_mode="continuous")
+    try:
+        futs = [mb.submit(np.ones((8, 4)))]      # worker busy dispatching
+        import time as _time
+        _time.sleep(0.05)
+        futs.append(mb.submit(np.ones((8, 4))))  # fills the queue bound
+        with pytest.raises(QueueFullError):
+            mb.submit(np.ones((8, 4)))
+        for f in futs:
+            np.testing.assert_allclose(f.result(timeout=60), 4.0)
+    finally:
+        mb.close()
+
+
 def test_batcher_propagates_worker_exceptions():
     X, y = _data(seed=9)
     bst, _ = _train(X, y)
