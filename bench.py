@@ -35,6 +35,9 @@ SKIP_SERVE = os.environ.get("BENCH_SKIP_SERVE", "") == "1"
 SKIP_LINEAR = os.environ.get("BENCH_SKIP_LINEAR", "") == "1"
 LINEAR_ROWS = int(os.environ.get("BENCH_LINEAR_ROWS", 500_000))
 LINEAR_ITER = int(os.environ.get("BENCH_LINEAR_ITERS", 15))
+SKIP_GOSS = os.environ.get("BENCH_SKIP_GOSS", "") == "1"
+GOSS_ROWS = int(os.environ.get("BENCH_GOSS_ROWS", 2_000_000))
+GOSS_ITER = int(os.environ.get("BENCH_GOSS_ITERS", 30))
 # non-empty = record host spans (trace_spans=on) and write the flight
 # recorder as Chrome trace-event JSON (Perfetto-loadable) to this path
 TRACE_PATH = os.environ.get("BENCH_TRACE", "")
@@ -113,6 +116,8 @@ def _phases(timer, wall, traffic=None):
         out["hist_gather_bytes_per_row"] = traffic["hist_bytes_per_row"]
         out["split_kernel"] = traffic.get("split_kernel", "off")
         out["launches_per_split"] = traffic.get("launches_per_split", 3)
+        out["effective_rows"] = traffic.get("effective_rows", 0)
+        out["goss_compact"] = traffic.get("goss_compact", "off")
     return out
 
 
@@ -233,6 +238,42 @@ def run_linear(lgb):
     }
 
 
+def run_goss(lgb):
+    """GOSS row-compaction A/B: full-train wall with every per-split pass
+    over all N padded rows (tpu_goss_compact=off) vs the sorted/sliced
+    survivor set of ceil((top_rate+other_rate)*N) rows (on). Kernel-level
+    A/B with measurement discipline lives in scripts/goss_bisect.py."""
+    from lightgbm_tpu import obs
+    X, y = make_higgs_like(GOSS_ROWS, seed=23)
+    params = {"objective": "binary", "num_leaves": NUM_LEAVES,
+              "max_bin": MAX_BIN, "learning_rate": 0.1, "verbosity": -1,
+              "boosting": "goss", "top_rate": 0.2, "other_rate": 0.1,
+              "tpu_iter_block": 10}
+    out = {}
+    eff = {}
+    for mode in ("off", "on"):
+        p = dict(params, tpu_goss_compact=mode)
+        ds = lgb.Dataset(X, label=y)
+        ds.construct()
+        lgb.train(dict(p), ds, num_boost_round=3)          # warmup/compile
+        with obs.wall("goss/train_" + mode) as wl:
+            bst = lgb.train(dict(p), ds, num_boost_round=GOSS_ITER)
+            obs.sync(bst.inner.train_score.score)
+        out[mode] = wl.seconds
+        tr = _traffic(bst) or {}
+        eff[mode] = tr.get("effective_rows", 0)
+    return {
+        "goss_off_s": round(out["off"], 3),
+        "goss_on_s": round(out["on"], 3),
+        "goss_speedup": round(out["off"] / max(out["on"], 1e-9), 3),
+        "goss_effective_rows": eff["on"],
+        "goss_unit": "train wall s (N=%d F=28 leaves=%d iters=%d "
+                     "top=0.2 other=0.1; effective rows off=%d on=%d)"
+                     % (GOSS_ROWS, NUM_LEAVES, GOSS_ITER, eff["off"],
+                        eff["on"]),
+    }
+
+
 def main():
     import jax
     jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
@@ -310,6 +351,12 @@ def main():
         except Exception as e:  # pragma: no cover - report, don't fail
             result["linear_error"] = "%s: %s" % (type(e).__name__,
                                                  str(e)[:200])
+    if not SKIP_GOSS:
+        try:
+            result.update(run_goss(lgb))
+        except Exception as e:  # pragma: no cover - report, don't fail
+            result["goss_error"] = "%s: %s" % (type(e).__name__,
+                                               str(e)[:200])
     # full structured-counter view of the run (dataset cache traffic, fused
     # dispatch/flush, per-tree growth, auto-knob resolutions, bench walls)
     result["telemetry"] = lgb.obs.telemetry.snapshot()

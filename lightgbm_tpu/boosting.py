@@ -349,6 +349,8 @@ class GBDT:
                                 spec["partition_bytes_per_row"])
                 telemetry.gauge("traffic/hist_bytes_per_row",
                                 spec["hist_bytes_per_row"])
+                telemetry.gauge("traffic/effective_rows",
+                                spec.get("effective_rows", 0))
                 telemetry.gauge("learner/launches_per_split",
                                 spec.get("launches_per_split", 3))
             if tree.num_leaves > 1:

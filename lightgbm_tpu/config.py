@@ -379,6 +379,27 @@ class Config:
     #   (scripts/forest_bisect.py); on: force where structurally eligible
     #   (booster trained in-process or with a constructed train_set, node
     #   tables within the VMEM budget).
+    tpu_goss_compact: str = "auto"   # auto|off|on: GOSS row compaction —
+    #   after the sampler emits the inbag mask, a device sort-by-inbag +
+    #   static-shape slice packs the surviving rows into a compact work
+    #   set sized ceil((top_rate+other_rate)*N) (+ a 4-sigma binomial
+    #   margin), so planes pack / partition / histograms / split scan all
+    #   run over the sample instead of N. The dense-mask path stays
+    #   verbatim as the bit-parity oracle (and as the in-graph fallback
+    #   for GOSS warmup iterations and margin overflow). auto: off
+    #   everywhere until scripts/goss_bisect.py validates the win on
+    #   hardware; on: force where eligible (GOSS sampling active, serial
+    #   training, not int8 — the stochastic-rounding draws are
+    #   row-position seeded).
+    tpu_hist_mxu: str = "auto"       # auto|off|on: one-hot MXU histogram —
+    #   a Pallas kernel (rows layout) that builds per-chunk one-hots in
+    #   VMEM and feeds the MXU via matmul, serving both the f32 hi/lo-16
+    #   path and the use_quantized_grad int8 path (int8 x int8 -> i32
+    #   accumulation) from one kernel body. The segment-histogram einsum
+    #   stays verbatim as the bit-parity oracle. auto: off everywhere
+    #   until scripts/hist_mxu_bisect.py validates the MXU lowering on
+    #   hardware; on: force where eligible (rows layout, pallas
+    #   partition widths, hist chunk % 32 == 0).
     use_quantized_grad: bool = False  # int8 stochastic gradient quantization
     #   (LightGBM 4.x quantized training analog; rows per leaf <= ~16M)
 
@@ -446,6 +467,12 @@ class Config:
         if self.tpu_forest_kernel not in ("auto", "off", "on"):
             Log.fatal("tpu_forest_kernel must be auto, off or on; got %s",
                       self.tpu_forest_kernel)
+        if self.tpu_goss_compact not in ("auto", "off", "on"):
+            Log.fatal("tpu_goss_compact must be auto, off or on; got %s",
+                      self.tpu_goss_compact)
+        if self.tpu_hist_mxu not in ("auto", "off", "on"):
+            Log.fatal("tpu_hist_mxu must be auto, off or on; got %s",
+                      self.tpu_hist_mxu)
         if self.serve_dispatch not in ("continuous", "coalesce"):
             Log.fatal("serve_dispatch must be continuous or coalesce; "
                       "got %s", self.serve_dispatch)

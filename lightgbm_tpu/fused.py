@@ -161,7 +161,10 @@ def make_sampler(config: Config, num_data: int):
         def goss(key, it, g, h):
             s = jnp.abs(g * h) if g.ndim == 1 else jnp.sum(jnp.abs(g * h), axis=1)
             top_k = max(1, int(num_data * top_rate))
-            thr = jnp.sort(s)[num_data - top_k]
+            # k-th largest via top_k (O(N log k)) — same multiset element as
+            # jnp.sort(s)[num_data - top_k], so `is_top` is bit-compatible
+            # with the full-sort threshold (pinned in test_goss_compact.py)
+            thr = jax.lax.top_k(s, top_k)[0][top_k - 1]
             is_top = s >= thr
             rest_rate = other_rate / max(1e-12, 1.0 - top_rate)
             u = jax.random.uniform(jax.random.fold_in(base, 7000 + it),
@@ -597,6 +600,8 @@ class FusedTrainer:
                             spec["partition_bytes_per_row"])
             telemetry.gauge("traffic/hist_bytes_per_row",
                             spec["hist_bytes_per_row"])
+            telemetry.gauge("traffic/effective_rows",
+                            spec.get("effective_rows", 0))
             telemetry.gauge("learner/launches_per_split",
                             spec.get("launches_per_split",
                                      3 if not one_kernel else 1))

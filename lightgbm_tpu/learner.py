@@ -689,6 +689,24 @@ def build_tree_partitioned(
     bins_res: Optional[jax.Array] = None,  # (F, Npad) resident bin planes
     # (work_layout=resident) — pass a block-hoisted copy when building
     # many trees; derived in-graph from ``bins`` when None
+    goss_compact_rows: int = 0,  # static compact row count M (tpu_goss_compact):
+    # when 0 < M < N, the inbag mask is turned into a device gather that
+    # packs the surviving rows to the top and the WHOLE tree build runs
+    # over M rows; GOSS warmup iterations (all rows in-bag) and the rare
+    # margin overflow fall back to the verbatim dense-mask build inside
+    # the same jitted graph (lax.cond) — bit-identical trees either way
+    route_bins: Optional[Tuple[jax.Array, Optional[jax.Array]]] = None,
+    # (bins_full, bins_t_full): route ALL original rows through the grown
+    # tree in assign_leaves (set by the compaction wrapper so row_leaf
+    # keeps the full (N,) shape the score update expects)
+    root_sum_in: Optional[jax.Array] = None,  # (3,) precomputed local root
+    # (g, h, cnt) sums. The compaction wrapper computes them over the
+    # DENSE ghc: XLA's row reduce uses strided accumulators, so summing
+    # the compacted array would regroup the f32 additions (+/-1 ulp) —
+    # histogram matmuls accumulate sequentially over rows and are immune
+    hist_mxu: str = "off",  # off | on: one-hot MXU histogram kernel
+    # (ops/histogram.py hist_mxu_segment — rows layout; serves both the
+    # f32 hi/lo and the int8 quantized path from one kernel body)
 ) -> TreeLog:
     """Grow one leaf-wise tree with a physical row partition.
 
@@ -705,9 +723,62 @@ def build_tree_partitioned(
     Same in/out contract as ``build_tree``; runs identically single-device
     or under shard_map (all collectives go through ``comm``).
     """
+    if goss_compact_rows and 0 < goss_compact_rows < bins.shape[0]:
+        # ---- GOSS device compaction (tpu_goss_compact=on) ----
+        # Gather the in-bag rows to the top and build the tree over a
+        # STATIC M-row prefix; removed rows carry exact (+/-0.0, 0) ghc so
+        # the compact build's sums, partitions and histograms match the
+        # dense-mask build bit-for-bit. The in-graph cond keeps the dense
+        # path for GOSS warmup iterations (sampler emits all-ones inbag,
+        # so C = N > M) and for binomial overflow beyond the 4-sigma
+        # margin. Both branches route ALL N original rows in
+        # assign_leaves, so row_leaf (and the score update) are
+        # shape-identical either way.
+        from .ops.partition import compact_rows_by_inbag
+        if return_work and work_buf is None:
+            raise ValueError("goss_compact_rows with return_work=True needs "
+                             "a carried work_buf (its M-sized shape is the "
+                             "cond's common work signature)")
+        m = goss_compact_rows
+        bins_c, ghc_c, c_in = compact_rows_by_inbag(bins, ghc, m)
+        sub = dict(
+            num_leaves=num_leaves, num_bin=num_bin, max_depth=max_depth,
+            feature_fraction_bynode=feature_fraction_bynode,
+            extra_trees=extra_trees, extra_seed=extra_seed, comm=comm,
+            hist_chunk=hist_chunk, part_chunk=part_chunk,
+            hist_mode=hist_mode, hist_lo=hist_lo,
+            num_bin_hist=num_bin_hist, bundle=bundle,
+            constraint_sets=constraint_sets, forced=forced,
+            part_kernel=part_kernel, hist_kernel=hist_kernel,
+            split_kernel=split_kernel, work_layout=work_layout,
+            goss_compact_rows=0, hist_mxu=hist_mxu,
+            return_work=return_work)
+
+        def _compact(_):
+            # root sums come from the DENSE ghc: the row reduce's strided
+            # accumulators would regroup f32 additions over the compacted
+            # array (+/-1 ulp — enough to flip near-tie splits)
+            return build_tree_partitioned(
+                bins_c, ghc_c, meta, feature_mask, key, cegb_used, hp,
+                work_buf=work_buf, bins_t=None, bins_res=None,
+                route_bins=(bins, bins_t),
+                root_sum_in=jnp.sum(ghc, axis=0), **sub)
+
+        def _dense(_):
+            # fresh internal N-sized buffers; the carried M-sized work_buf
+            # passes through untouched so both cond branches return the
+            # same work signature
+            out = build_tree_partitioned(
+                bins, ghc, meta, feature_mask, key, cegb_used, hp,
+                work_buf=None, bins_t=bins_t, bins_res=bins_res,
+                route_bins=route_bins, **dict(sub, return_work=False))
+            return (out, work_buf) if return_work else out
+
+        return jax.lax.cond(c_in <= m, _compact, _dense, 0)
+
     from .ops.histogram import (hist16_segment, hist16_segment_planes,
                                 hist16_segment_q, hist16_segment_resident,
-                                hist_pallas_segment,
+                                hist_mxu_segment, hist_pallas_segment,
                                 hist_pallas_segment_planes)
     from .ops.partition import (one_kernel_split_planes,
                                 pack_planes_fold_root,
@@ -758,6 +829,17 @@ def build_tree_partitioned(
             bad.append("hist_chunk must be a multiple of 128")
         if bad:
             raise ValueError("tpu_split_kernel=on is not eligible here: "
+                             + "; ".join(bad))
+    if hist_mxu == "on":
+        bad = []
+        if planes:
+            bad.append("needs the rows work layout")
+        if not fused_part:
+            bad.append("needs part_kernel=pallas (128-lane work rows)")
+        if hist_chunk % 32:
+            bad.append("hist_chunk must be a multiple of 32")
+        if bad:
+            raise ValueError("tpu_hist_mxu=on is not eligible here: "
                              + "; ".join(bad))
 
     # ---- packed ping-pong working buffers with guard rows ----
@@ -862,10 +944,24 @@ def build_tree_partitioned(
                                       num_feat=num_grp,
                                       exact=hist_mode != "bf16",
                                       chunk=hist_chunk, lo_w=hist_lo)
+        elif quantized and hist_mxu == "on":
+            # int8 one-hots x int8 channels -> i32 on the MXU; integer
+            # accumulation makes parity with hist16_segment_q exact
+            h, work = hist_mxu_segment(work, plane, start, cnt,
+                                       num_bins=bm, num_feat=num_grp,
+                                       quantized=True, gscale=gscale,
+                                       hscale=hscale, chunk=hist_chunk,
+                                       lo_w=hist_lo)
         elif quantized:
             h = hist16_segment_q(work, plane, start, cnt, gscale, hscale,
                                  num_bins=bm, num_feat=num_grp,
                                  chunk=hist_chunk, lo_w=hist_lo)
+        elif hist_mxu == "on":
+            h, work = hist_mxu_segment(work, plane, start, cnt,
+                                       num_bins=bm, num_feat=num_grp,
+                                       quantized=False,
+                                       exact=hist_mode != "bf16",
+                                       chunk=hist_chunk, lo_w=hist_lo)
         elif hist_kernel == "pallas":
             # in-VMEM chunk loop + accumulator: one streamed read of the
             # segment, none of the XLA loop's per-chunk parasitic fusions
@@ -981,7 +1077,8 @@ def build_tree_partitioned(
                         node_depth=depth, adv_bounds=adv_b)
 
     # ---- init: root ----
-    root_sum_loc = jnp.sum(ghc, axis=0)
+    root_sum_loc = jnp.sum(ghc, axis=0) if root_sum_in is None \
+        else root_sum_in
     root_sum = comm.root(root_sum_loc)
     if planes:
         # folded into the pack pass above (bit-identical accumulation to
@@ -1369,8 +1466,9 @@ def build_tree_partitioned(
     carry = jax.lax.while_loop(cond, body, carry0)
     (_, work_fin, _, _, _, _, leaf_sum, _, leaf_out, _, _, _, _, log, _, _,
      _, _) = carry
-    row_leaf = assign_leaves(bins, log, has_categorical=hp.has_categorical,
-                             bundle=bundle, bins_t=bins_t)
+    rb, rbt = (bins, bins_t) if route_bins is None else route_bins
+    row_leaf = assign_leaves(rb, log, has_categorical=hp.has_categorical,
+                             bundle=bundle, bins_t=rbt)
     log = log._replace(leaf_value=leaf_out, leaf_sum=leaf_sum,
                        row_leaf=row_leaf)
     if return_work:
@@ -1677,7 +1775,9 @@ class SerialTreeLearner:
                          "tpu_work_layout": ("planes", "rows"),
                          "tpu_resident_state": ("resident", "off"),
                          "tpu_split_kernel": ("on", "off"),
-                         "tpu_forest_kernel": ("on", "off")}
+                         "tpu_forest_kernel": ("on", "off"),
+                         "tpu_goss_compact": ("on", "off"),
+                         "tpu_hist_mxu": ("on", "off")}
                 for k, v in raw.items():
                     if k in valid and v in valid[k]:
                         pre[k] = v
@@ -1917,6 +2017,86 @@ class SerialTreeLearner:
             # VMEM budget) is per-model state — boosting._forest_model
             # re-checks it on every pack; only the knob resolves here
             self._forest_kernel = fk
+            from .ops.partition import goss_compact_rows as _gcr
+            n_rows = int(self.bins.shape[0])
+            goss_active = (config.data_sample_strategy == "goss"
+                           and float(config.top_rate)
+                           + float(config.other_rate) < 1.0)
+            m_rows = _gcr(n_rows, float(config.top_rate),
+                          float(config.other_rate)) if goss_active else 0
+            gc = config.tpu_goss_compact
+            auto_gc = gc == "auto"
+            gc_why = ""
+            if auto_gc and "tpu_goss_compact" in pre:
+                gc = _pre("tpu_goss_compact")
+                auto_gc = False
+            elif auto_gc:
+                # auto = off: compaction's bit-parity with the dense-mask
+                # path is proven under the CPU interpreter, but the gather
+                # + compact-build wall-clock win is unmeasured on hardware.
+                gc = "off"
+                if goss_active:
+                    gc_why = ("GOSS compaction parity proven under "
+                              "interpret only; gather + compact-build "
+                              "unmeasured on TPU — run "
+                              "scripts/goss_bisect.py to validate, then "
+                              "enable via knob or ledger")
+                else:
+                    gc_why = ("no GOSS sampling in this config "
+                              "(data_sample_strategy=%s)"
+                              % config.data_sample_strategy)
+            if gc == "on":
+                bad = []
+                if not goss_active:
+                    bad.append("no GOSS sampling in this config")
+                if mode == "int8":
+                    bad.append("int8 stochastic-rounding draws are "
+                               "row-position seeded (compaction would "
+                               "change the quantization stream)")
+                if self.comm.axis is not None:
+                    bad.append("multi-device comm unsupported (per-shard "
+                               "compact/dense cond would diverge)")
+                if goss_active and m_rows >= n_rows:
+                    bad.append("sample rates leave no rows to drop")
+                if bad:
+                    Log.warning("tpu_goss_compact=on is not eligible here "
+                                "(%s); using the dense-mask path",
+                                "; ".join(bad))
+                    gc = "off"
+                    if auto_gc:
+                        gc_why = "structurally ineligible: " + "; ".join(bad)
+            hm = config.tpu_hist_mxu
+            auto_hm = hm == "auto"
+            hm_why = ""
+            if auto_hm and "tpu_hist_mxu" in pre:
+                hm = _pre("tpu_hist_mxu")
+                auto_hm = False
+            elif auto_hm:
+                # auto = off: the one-hot MXU kernel's bit-parity is proven
+                # under the CPU interpreter, but its Mosaic/MXU lowering
+                # (int8 x int8 -> i32 dots especially) is unvalidated on
+                # real hardware.
+                hm = "off"
+                hm_why = ("one-hot MXU histogram parity proven under "
+                          "interpret only; MXU lowering unmeasured on TPU "
+                          "— run scripts/hist_mxu_bisect.py to validate, "
+                          "then enable via knob or ledger")
+            if hm == "on":
+                bad = []
+                if layout in ("planes", "resident"):
+                    bad.append("needs the rows work layout")
+                if part_kernel != "pallas":
+                    bad.append("needs part_kernel=pallas (128-lane work "
+                               "rows)")
+                if hist_chunk % 32:
+                    bad.append("hist_chunk must be a multiple of 32")
+                if bad:
+                    Log.warning("tpu_hist_mxu=on is not eligible here "
+                                "(%s); using the XLA einsum path",
+                                "; ".join(bad))
+                    hm = "off"
+                    if auto_hm:
+                        hm_why = "structurally ineligible: " + "; ".join(bad)
             # auto-knob resolution records: what auto chose and why
             # (deduped, so repeated build_kwargs calls keep one record per
             # distinct resolution)
@@ -1953,6 +2133,10 @@ class SerialTreeLearner:
                 _rec("tpu_split_kernel", sk, sk_why)
             if auto_fk:
                 _rec("tpu_forest_kernel", fk, fk_why)
+            if auto_gc:
+                _rec("tpu_goss_compact", gc, gc_why)
+            if auto_hm:
+                _rec("tpu_hist_mxu", hm, hm_why)
             kw.update(
                 hist_chunk=hist_chunk,
                 part_chunk=part_chunk,
@@ -1964,6 +2148,8 @@ class SerialTreeLearner:
                 hist_kernel=hist_kernel,
                 split_kernel=sk,
                 work_layout=layout,
+                goss_compact_rows=m_rows if gc == "on" else 0,
+                hist_mxu=hm,
             )
         else:
             kw.update(
@@ -2050,6 +2236,12 @@ class SerialTreeLearner:
                              kw["part_chunk"], kw["hist_chunk"],
                              layout=kw["work_layout"])
         n = self.bins.shape[0]
+        m = kw.get("goss_compact_rows", 0)
+        if 0 < m < n:
+            # GOSS compaction: the carried buffer serves the compact
+            # branch (the dense warmup/overflow branch allocates its own
+            # N-sized buffers in-graph)
+            n = m
         if kw["work_layout"] in ("planes", "resident"):
             return ((2, w, planes_npad(n, guard, kw["part_kernel"])),
                     jnp.uint8)
@@ -2098,10 +2290,17 @@ class SerialTreeLearner:
         else:
             hist = w                    # row-major reads the packed row
         one_kernel = kw.get("split_kernel", "off") == "on"
+        n = int(self.bins.shape[0])
+        m = int(kw.get("goss_compact_rows", 0))
         return {"work_layout": layout, "work_width": int(w),
                 "partition_bytes_per_row": int(part),
                 "hist_bytes_per_row": int(hist),
                 "split_kernel": kw.get("split_kernel", "off"),
+                "hist_mxu": kw.get("hist_mxu", "off"),
+                # rows every downstream pass scans per tree: the GOSS
+                # compact prefix when compaction resolved on, else N
+                "effective_rows": m if 0 < m < n else n,
+                "goss_compact": "on" if 0 < m < n else "off",
                 # device launches per split on this config: partition +
                 # child histogram + split scan, or the fused one-kernel
                 "launches_per_split": 1 if one_kernel else 3}

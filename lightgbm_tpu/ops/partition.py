@@ -41,6 +41,7 @@ Buffers carry a CH-row guard region at BOTH ends (rows live in
 """
 from __future__ import annotations
 
+import math
 import os
 from functools import partial
 from typing import Tuple
@@ -552,6 +553,44 @@ def work_spec(num_groups: int, quantized: bool, part_kernel: str,
     return guard, width
 
 
+def goss_compact_rows(n: int, top_rate: float, other_rate: float) -> int:
+    """Static compact-row count M for GOSS device compaction.
+
+    top_k rows survive deterministically; of the remaining ``rest`` each
+    survives independently with p = other_rate / (1 - top_rate), so the
+    surviving count is top_k + Binomial(rest, p). M adds a 4-sigma margin
+    (+32 slack for tiny shapes) so the in-graph compact/dense cond takes
+    the compact branch for essentially every draw; the rare overflow
+    (and every GOSS warmup iteration, which samples ALL rows) falls back
+    to the verbatim dense-mask path inside the same jitted graph. M is a
+    pure function of (n, rates) — shapes stay bucket-stable and the
+    zero-recompile contract holds.
+    """
+    top_k = max(1, int(n * top_rate))
+    rest = max(0, n - top_k)
+    p = min(1.0, other_rate / max(1e-12, 1.0 - top_rate))
+    slack = 4.0 * math.sqrt(rest * p * (1.0 - p)) + 32.0
+    return min(n, top_k + int(math.ceil(rest * p + slack)))
+
+
+def compact_rows_by_inbag(bins: jax.Array, ghc: jax.Array, m: int):
+    """Gather the first M in-bag rows (original relative order) to the top.
+
+    Returns (bins[:M] packed, ghc[:M] packed, in-bag count C). The sort key
+    is the integer ``row + n*outbag`` — distinct per row, so argsort is
+    order-deterministic without relying on a stable-sort kwarg: in-bag rows
+    first, each side in original row order. When C > M the gather is
+    truncated (caller must take the dense branch — checked via C).
+    """
+    n = bins.shape[0]
+    inbag = ghc[:, 2] > 0
+    iota = jnp.arange(n, dtype=jnp.int32)
+    order = jnp.argsort(jnp.where(inbag, iota, iota + n))
+    idx = jax.lax.slice_in_dim(order, 0, m)
+    return (jnp.take(bins, idx, axis=0), jnp.take(ghc, idx, axis=0),
+            jnp.sum(inbag.astype(jnp.int32)))
+
+
 def planes_npad(n: int, guard: int, part_kernel: str = "xla") -> int:
     """Lane count of the planes work buffer: segment lanes + guards, padded
     to whole 128-lane tiles when the pallas kernel DMAs it."""
@@ -845,8 +884,11 @@ def _partition_kernel(sref, work_in, work_ref, lt_ref,
         # re-reads what full tiles just wrote (identical) or, when d < ch,
         # pre-segment bytes that must be preserved
         at = a32(dstart + d - ch)
+        # read via the OUTPUT ref: on TPU it aliases work_in, but interpret
+        # mode keeps distinct buffers and only work_ref holds the rows the
+        # full drain tiles just wrote (planes kernel precedent, line ~1205)
         rd = pltpu.make_async_copy(
-            work_in.at[dst_plane, pl.ds(at, ch), :], lfb.at[0], sem.at[4])
+            work_ref.at[dst_plane, pl.ds(at, ch), :], lfb.at[0], sem.at[4])
         rd.start()
         rd.wait()
         tile = drain_tile(d - ch)
@@ -930,6 +972,7 @@ def partition_segment_fused(
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",),
             vmem_limit_bytes=100 * 1024 * 1024),
+        interpret=_INTERPRET,
     )(scalars, work)
     return work_out, lt[0]
 

@@ -39,12 +39,13 @@ echo "== lint tests =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_lint.py -q -m 'not slow'
 
 if [ "$RUN_SUBSET" = 1 ]; then
-    echo "== serve/online/obs/linear/one-kernel/forest fast tests =="
+    echo "== serve/online/obs/linear/one-kernel/forest/goss-mxu fast tests =="
     JAX_PLATFORMS=cpu python -m pytest -q -m 'not slow' \
         tests/test_serve.py tests/test_online.py \
         tests/test_obs.py tests/test_trace.py \
         tests/test_linear_device.py tests/test_one_kernel.py \
-        tests/test_forest_kernel.py
+        tests/test_forest_kernel.py tests/test_goss_compact.py \
+        tests/test_hist_mxu.py
 fi
 
 if [ "$RUN_FLEET" = 1 ]; then

@@ -1,0 +1,249 @@
+"""int8 one-hot MXU histogram kernel (tpu_hist_mxu): parity with the
+segment-einsum oracle.
+
+hist_mxu_segment (ops/histogram.py, ISSUE 17) builds per-chunk one-hot
+matrices in VMEM and contracts them on the MXU: one kernel body serves
+BOTH gradient representations — the f32 path splits g/h into bf16
+hi/lo-16 channels (same exact-decomposition as the rows pallas hist)
+and accumulates in f32, the use_quantized_grad path decodes the int8
+payload bytes and feeds an int8 x one-hot dot_general with i32
+accumulation (integer adds are order-free, so parity with the host
+quantized semantics is EXACT, stochastic-rounding seed contract
+included). These tests pin both contracts bitwise under the pallas
+interpreter, the wrapper validations, the auto-knob gates and the
+zero-recompile discipline.
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+import lightgbm_tpu as lgb  # noqa: E402
+from lightgbm_tpu import obs  # noqa: E402
+from lightgbm_tpu.ops import partition as P  # noqa: E402
+from lightgbm_tpu.ops.histogram import (hist16_segment,  # noqa: E402
+                                        hist16_segment_q, hist_mxu_segment)
+
+CH = 256
+
+BASE = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+        "tpu_iter_block": 2, "tpu_work_layout": "rows",
+        "tpu_partition_kernel": "pallas", "tpu_part_chunk": CH,
+        "tpu_hist_chunk": CH}
+
+
+def _pack(rng, n, f, nb, quantized, seed_key=7):
+    guard, width = P.work_spec(f, quantized, "pallas", CH, CH, layout="rows")
+    bins = rng.randint(0, nb, size=(n, f)).astype(np.uint8)
+    ghc = rng.randn(n, 3).astype(np.float32)
+    mask = rng.rand(n) < 0.8
+    ghc[:, 2] = mask
+    ghc[:, 0] *= mask
+    ghc[:, 1] = np.abs(ghc[:, 1]) * mask
+    pad = ((guard, guard), (0, 0))
+    gscale = hscale = None
+    if quantized:
+        gscale = jnp.float32(127.0) / float(np.abs(ghc[:, 0]).max() + 1e-12)
+        hscale = jnp.float32(127.0) / float(np.abs(ghc[:, 1]).max() + 1e-12)
+        w0 = P.pack_rows_quantized(
+            jnp.pad(jnp.asarray(bins), pad), jnp.pad(jnp.asarray(ghc), pad),
+            jax.random.PRNGKey(seed_key), gscale, hscale)
+    else:
+        w0 = P.pack_rows(jnp.pad(jnp.asarray(bins), pad),
+                         jnp.pad(jnp.asarray(ghc), pad))
+    w0 = jnp.pad(w0, ((0, 0), (0, width - w0.shape[1])))
+    return jnp.stack([w0, jnp.zeros_like(w0)]), guard, gscale, hscale
+
+
+# --------------------------------------------------------------- op level
+
+def test_op_parity_f32(rng, monkeypatch):
+    """f32 hi/lo-16 mode vs hist16_segment: byte-identical, including
+    unaligned starts and partial trailing chunks."""
+    monkeypatch.setattr(P, "_INTERPRET", True)
+    n, f, nb = 1500, 8, 64
+    work, guard, _, _ = _pack(rng, n, f, nb, quantized=False)
+    for start, cnt in [(guard, n), (guard + 37, 411), (guard + 1, 31)]:
+        ho = hist16_segment(work, jnp.int32(0), jnp.int32(start),
+                            jnp.int32(cnt), num_bins=nb, num_feat=f,
+                            chunk=CH)
+        hk, _ = hist_mxu_segment(work, jnp.int32(0), jnp.int32(start),
+                                 jnp.int32(cnt), num_bins=nb, num_feat=f,
+                                 chunk=CH)
+        assert hk.dtype == ho.dtype and hk.shape == ho.shape
+        assert np.array_equal(np.asarray(hk).view(np.uint8),
+                              np.asarray(ho).view(np.uint8)), (start, cnt)
+
+
+def test_op_parity_int8(rng, monkeypatch):
+    """Quantized mode vs hist16_segment_q: identical down to the dequant
+    bytes — the int8 matmul with i32 accumulation reproduces the host
+    quantized semantics exactly (same packed dither bytes in, integer
+    adds are order-free)."""
+    monkeypatch.setattr(P, "_INTERPRET", True)
+    n, f, nb = 1500, 8, 64
+    work, guard, gscale, hscale = _pack(rng, n, f, nb, quantized=True)
+    for start, cnt in [(guard, n), (guard + 37, 411), (guard + 3, 130)]:
+        ho = hist16_segment_q(work, jnp.int32(0), jnp.int32(start),
+                              jnp.int32(cnt), gscale, hscale, num_bins=nb,
+                              num_feat=f, chunk=CH)
+        hk, _ = hist_mxu_segment(work, jnp.int32(0), jnp.int32(start),
+                                 jnp.int32(cnt), num_bins=nb, num_feat=f,
+                                 quantized=True, gscale=gscale,
+                                 hscale=hscale, chunk=CH)
+        assert np.array_equal(np.asarray(hk).view(np.uint8),
+                              np.asarray(ho).view(np.uint8)), (start, cnt)
+
+
+def test_op_validations():
+    work = jnp.zeros((2, 640, 100), jnp.uint8)    # width not 128-lane
+    with pytest.raises(ValueError, match="128-lane"):
+        hist_mxu_segment(work, jnp.int32(0), jnp.int32(64), jnp.int32(256),
+                         num_bins=32, num_feat=4, chunk=256)
+    work = jnp.zeros((2, 640, 128), jnp.uint8)
+    with pytest.raises(ValueError, match="chunk"):
+        hist_mxu_segment(work, jnp.int32(0), jnp.int32(64), jnp.int32(256),
+                         num_bins=32, num_feat=4, chunk=100)
+    with pytest.raises(ValueError, match="gscale"):
+        hist_mxu_segment(work, jnp.int32(0), jnp.int32(64), jnp.int32(256),
+                         num_bins=32, num_feat=4, quantized=True, chunk=256)
+
+
+# ----------------------------------------------------- full-train parity
+
+def _model(params, X, y, rounds=4):
+    ds = lgb.Dataset(X, label=y, params=dict(params))
+    bst = lgb.train(dict(params), ds, num_boost_round=rounds)
+    return bst.model_to_string()
+
+
+@pytest.mark.slow
+def test_train_parity_f32(rng, monkeypatch):
+    monkeypatch.setattr(P, "_INTERPRET", True)
+    n = 700
+    X = rng.randn(n, 8)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+    on = _model(dict(BASE, tpu_hist_mxu="on"), X, y)
+    off = _model(dict(BASE, tpu_hist_mxu="off"), X, y)
+    assert on == off
+
+
+@pytest.mark.slow
+def test_train_parity_int8(rng, monkeypatch):
+    """use_quantized_grad path: the one kernel body also serves the int8
+    representation — byte parity including the stochastic-rounding seed
+    contract (pack_rows_quantized draws ride the work buffer unchanged)."""
+    monkeypatch.setattr(P, "_INTERPRET", True)
+    n = 700
+    X = rng.randn(n, 8)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+    on = _model(dict(BASE, tpu_hist_mxu="on", use_quantized_grad=True),
+                X, y)
+    off = _model(dict(BASE, tpu_hist_mxu="off", use_quantized_grad=True),
+                 X, y)
+    assert on == off
+
+
+@pytest.mark.slow
+def test_train_parity_goss_compact_composition(rng, monkeypatch):
+    """The two ISSUE 17 multipliers compose: compacted GOSS rows through
+    the MXU kernel vs the dense einsum oracle, byte for byte."""
+    monkeypatch.setattr(P, "_INTERPRET", True)
+    n = 700
+    X = rng.randn(n, 8)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+    goss = {"boosting": "goss", "top_rate": 0.3, "other_rate": 0.2,
+            "learning_rate": 0.5}
+    on = _model(dict(BASE, tpu_hist_mxu="on", tpu_goss_compact="on",
+                     **goss), X, y, rounds=6)
+    off = _model(dict(BASE, tpu_hist_mxu="off", tpu_goss_compact="off",
+                      **goss), X, y, rounds=6)
+    assert on == off
+
+
+# --------------------------------------------------- telemetry + retrace
+
+@pytest.mark.slow
+def test_second_identical_train_compiles_nothing(rng, monkeypatch):
+    """test_retrace.py discipline on the MXU path: a second train at
+    identical shapes/config hits every jit cache — zero new compiles."""
+    monkeypatch.setattr(P, "_INTERPRET", True)
+    n = 540                      # shape distinct from other test modules
+    X = rng.randn(n, 7)
+    y = (X @ rng.randn(7) > 0).astype(np.float64)
+    params = dict(BASE, tpu_hist_mxu="on")
+    ds = lgb.Dataset(X, label=y, params=dict(params))
+    lgb.train(dict(params), ds, num_boost_round=2)   # warm every cache
+    obs.telemetry.reset()
+    bst = lgb.train(dict(params), ds, num_boost_round=2)
+    jc = bst.telemetry()["jit_compiles"]
+    assert jc["total"] == 0, jc
+    assert jc["backend_compiles"] == 0, jc
+
+
+# ------------------------------------------------------------ knob gates
+
+def test_config_rejects_bad_hist_mxu():
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.utils.log import LightGBMError
+
+    with pytest.raises(LightGBMError, match="tpu_hist_mxu"):
+        Config.from_params({"tpu_hist_mxu": "maybe"})
+
+
+def test_auto_resolves_off_with_record(rng):
+    """auto stays off until scripts/hist_mxu_bisect.py validates the
+    Mosaic lowering and a win on real hardware."""
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.dataset import construct_dataset
+    from lightgbm_tpu.learner import SerialTreeLearner
+
+    X = rng.randn(300, 4)
+    y = (X[:, 0] > 0).astype(np.float64)
+    cfg = Config.from_params({"objective": "binary", "num_leaves": 4,
+                              "max_bin": 15, "verbosity": -1})
+    ds = construct_dataset(X, cfg, label=y)
+    obs.telemetry.reset()
+    kw = SerialTreeLearner(cfg, ds).build_kwargs()
+    assert kw["hist_mxu"] == "off"
+    recs = obs.telemetry.snapshot()["records"]["auto_resolution"]
+    mine = [r for r in recs if r["knob"] == "tpu_hist_mxu"]
+    assert len(mine) == 1
+    assert mine[0]["value"] == "off"
+    assert "hist_mxu_bisect" in mine[0]["reason"]
+
+
+def test_ineligible_on_downgrades_to_off(rng):
+    """Forcing on where the structure can't support it warns and keeps the
+    einsum path instead of failing the train."""
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.dataset import construct_dataset
+    from lightgbm_tpu.learner import SerialTreeLearner
+
+    X = rng.randn(300, 4)
+    y = (X[:, 0] > 0).astype(np.float64)
+    # planes layout: the kernel reads 128-lane work ROWS
+    cfg = Config.from_params(dict(BASE, num_leaves=4, max_bin=15,
+                                  tpu_work_layout="planes",
+                                  tpu_hist_mxu="on"))
+    ds = construct_dataset(X, cfg, label=y)
+    assert SerialTreeLearner(cfg, ds).build_kwargs()["hist_mxu"] == "off"
+    # xla partition: row width is not padded to whole 128-lane tiles
+    cfg = Config.from_params({"objective": "binary", "num_leaves": 4,
+                              "max_bin": 15, "verbosity": -1,
+                              "tpu_work_layout": "rows",
+                              "tpu_hist_mxu": "on"})
+    ds = construct_dataset(X, cfg, label=y)
+    assert SerialTreeLearner(cfg, ds).build_kwargs()["hist_mxu"] == "off"
+    # hist chunk not a multiple of the 32-row DMA alignment
+    cfg = Config.from_params(dict(BASE, num_leaves=4, max_bin=15,
+                                  tpu_hist_chunk=100, tpu_hist_mxu="on"))
+    ds = construct_dataset(X, cfg, label=y)
+    assert SerialTreeLearner(cfg, ds).build_kwargs()["hist_mxu"] == "off"
