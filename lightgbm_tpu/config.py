@@ -449,6 +449,24 @@ class Config:
             Log.fatal("GOSS requires top_rate + other_rate <= 1.0")
         if self.objective in ("multiclass", "multiclassova", "softmax", "ova") and self.num_class <= 1:
             Log.fatal("num_class must be > 1 for multiclass objectives")
+        if self.tpu_rows_per_chunk < 1:
+            Log.fatal("tpu_rows_per_chunk must be >= 1, got %d",
+                      self.tpu_rows_per_chunk)
+        if self.tpu_iter_block < 1:
+            Log.fatal("tpu_iter_block must be >= 1, got %d",
+                      self.tpu_iter_block)
+        if self.tpu_part_chunk < 0:
+            Log.fatal("tpu_part_chunk must be >= 0 (0 = auto), got %d",
+                      self.tpu_part_chunk)
+        if self.tpu_partition_kernel not in ("auto", "pallas", "xla"):
+            Log.fatal("tpu_partition_kernel must be auto, pallas or xla; "
+                      "got %s", self.tpu_partition_kernel)
+        if self.tpu_hist_chunk < 0:
+            Log.fatal("tpu_hist_chunk must be >= 0 (0 = auto), got %d",
+                      self.tpu_hist_chunk)
+        if self.tpu_hist_precision not in ("hilo", "bf16", "int8"):
+            Log.fatal("tpu_hist_precision must be hilo, bf16 or int8; "
+                      "got %s", self.tpu_hist_precision)
         if self.tpu_hist_lo not in (0, 2, 4, 8, 16):
             Log.fatal("tpu_hist_lo must be one of 0 (auto), 2, 4, 8, 16; "
                       "got %d", self.tpu_hist_lo)

@@ -30,5 +30,6 @@ from .core import (  # noqa: F401
     run,
     save_baseline,
     split_new_findings,
+    stale_baseline_entries,
 )
 from . import rules  # noqa: F401  (importing registers the rule set)

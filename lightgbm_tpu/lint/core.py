@@ -254,6 +254,31 @@ def load_baseline(path: str) -> dict:
         return json.load(f)
 
 
+def stale_baseline_entries(root: str, baseline: dict) -> List[dict]:
+    """Baseline entries whose ``(path, text)`` no longer matches any
+    source line — the frozen finding was fixed (or its file deleted)
+    without the baseline shrinking. Text-based, like the baseline keys
+    themselves, so the check needs no lint run: ``check.sh`` fails on
+    drift in every mode, including ``--fast`` where only changed files
+    are linted."""
+    out: List[dict] = []
+    cache: Dict[str, set] = {}
+    for e in baseline.get("findings", []):
+        path = e.get("path", "")
+        lines = cache.get(path)
+        if lines is None:
+            try:
+                with open(os.path.join(root, path), "r", encoding="utf-8",
+                          errors="replace") as fh:
+                    lines = {ln.strip() for ln in fh}
+            except OSError:
+                lines = set()
+            cache[path] = lines
+        if e.get("text", "") not in lines:
+            out.append(e)
+    return out
+
+
 def split_new_findings(findings: Sequence[Finding], baseline: dict
                        ) -> Tuple[List[Finding], List[Finding]]:
     """Partition into (new, baselined). A finding is baselined while its

@@ -654,6 +654,174 @@ class ProjectGraph:
                     work.append(fn)
         return hot
 
+    # ----------------------------------------------------------- ref dataflow
+    def ref_events(self, fn: FuncInfo,
+                   refs: Dict[str, str]) -> List["RefEvent"]:
+        """Ordered read/write facts on kernel ``Ref`` parameters (ISSUE
+        19 tentpole): the events of ``fn``'s full body — nested
+        ``fori_loop`` bodies included — on the refs in ``refs`` (local
+        name -> canonical name), with calls to project helpers that
+        receive a tracked ref inlined at the call site (bounded depth,
+        cycle-guarded). See :class:`RefEvent`."""
+        return _ref_events_scan(self, fn, refs, 0, {id(fn)})
+
+
+class RefEvent:
+    """One ordered access to a Pallas kernel ``Ref`` parameter.
+
+    ``kind`` is ``"read"`` or ``"write"``; ``ref`` is the *canonical*
+    ref name handed to :meth:`ProjectGraph.ref_events` (stable across
+    call inlining, so a helper that receives ``work_in`` under another
+    parameter name still reports events against ``work_in``); ``label``
+    is the region tag — the dotted/constant text of the first subscript
+    index (``work_ref.at[dst_plane, ...]`` -> ``"dst_plane"``) or
+    ``None`` for whole-ref / dynamically-indexed accesses."""
+
+    __slots__ = ("kind", "ref", "label", "file", "node")
+
+    def __init__(self, kind: str, ref: str, label: Optional[str],
+                 file, node: ast.AST) -> None:
+        self.kind = kind
+        self.ref = ref
+        self.label = label
+        self.file = file
+        self.node = node
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return "<%s %s[%s] @%d>" % (self.kind, self.ref, self.label,
+                                    getattr(self.node, "lineno", 0))
+
+
+def _region_label(index: ast.AST) -> Optional[str]:
+    """The leading-axis tag of a subscript: first tuple element as a
+    dotted name or constant; ``None`` when it is computed (a ``pl.ds``
+    window, arithmetic, ...) — callers treat ``None`` conservatively."""
+    if isinstance(index, ast.Tuple) and index.elts:
+        index = index.elts[0]
+    if isinstance(index, ast.Constant):
+        return str(index.value)
+    name = dotted(index)
+    return name or None
+
+
+def _ref_target(node: ast.AST, refs: Dict[str, str]):
+    """Decode a ref-view expression to ``(canonical name, label)``:
+    a bare ``Name``, ``ref[...]`` or ``ref.at[...]``; ``None`` for
+    anything else (scratch refs, semaphores, unrelated values)."""
+    if isinstance(node, ast.Name):
+        canon = refs.get(node.id)
+        return (canon, None) if canon is not None else None
+    if isinstance(node, ast.Subscript):
+        base = node.value
+        if isinstance(base, ast.Attribute) and base.attr == "at":
+            base = base.value
+        if isinstance(base, ast.Name):
+            canon = refs.get(base.id)
+            if canon is not None:
+                return (canon, _region_label(node.slice))
+    return None
+
+
+def _event_node_key(n: ast.AST):
+    # same-line stores sort after loads: ``out[i] = f(in_[i])`` reads
+    # the RHS before the store commits, and the textual order would
+    # otherwise report a spurious read-after-write on that line
+    store = isinstance(n, ast.Subscript) and isinstance(n.ctx, ast.Store)
+    return (n.lineno, 1 if store else 0, n.col_offset)
+
+
+def _ref_events_scan(g: "ProjectGraph", fn: FuncInfo, refs: Dict[str, str],
+                     depth: int, seen: Set[int]) -> List[RefEvent]:
+    """Ordered read/write events on ``refs`` (local name -> canonical
+    name) over ``fn``'s FULL body — nested defs included, because Pallas
+    kernels close over their refs in ``fori_loop`` bodies. Calls to
+    non-nested project functions that receive a tracked ref positionally
+    (or by keyword) are inlined at the call site with the parameter map
+    substituted, bounded by ``depth`` and a cycle guard."""
+    events: List[RefEvent] = []
+    nodes = [n for n in ast.walk(fn.node)
+             if isinstance(n, (ast.Subscript, ast.Call, ast.AugAssign))
+             and hasattr(n, "lineno")]
+    nodes.sort(key=_event_node_key)
+    consumed: Set[int] = set()
+
+    def emit(kind: str, dec, node: ast.AST) -> None:
+        events.append(RefEvent(kind, dec[0], dec[1], fn.file, node))
+
+    def consume(node: ast.AST) -> None:
+        if isinstance(node, ast.Subscript):
+            consumed.add(id(node))
+
+    for node in nodes:
+        if id(node) in consumed:
+            continue
+        if isinstance(node, ast.Call):
+            tail = dotted(node.func).rsplit(".", 1)[-1]
+            if tail == "make_async_copy" and len(node.args) >= 2:
+                # pltpu.make_async_copy(src, dst, sem): src read, dst written
+                for idx, kind in ((0, "read"), (1, "write")):
+                    dec = _ref_target(node.args[idx], refs)
+                    if dec is not None:
+                        emit(kind, dec, node.args[idx])
+                        consume(node.args[idx])
+                continue
+            if tail in ("load", "store") and node.args:
+                dec = _ref_target(node.args[0], refs)
+                if dec is not None:
+                    emit("read" if tail == "load" else "write", dec, node)
+                    consume(node.args[0])
+                continue
+            if isinstance(node.func, ast.Name) and depth < 3:
+                events.extend(_ref_events_call(g, fn, refs, node,
+                                               depth, seen))
+        elif isinstance(node, ast.AugAssign):
+            dec = _ref_target(node.target, refs)
+            if dec is not None:
+                emit("read", dec, node.target)
+                emit("write", dec, node.target)
+                consume(node.target)
+        elif isinstance(node, ast.Subscript):
+            if isinstance(node.value, ast.Name):
+                dec = _ref_target(node, refs)
+                if dec is not None:
+                    emit("write" if isinstance(node.ctx, ast.Store)
+                         else "read", dec, node)
+    return events
+
+
+def _ref_events_call(g: "ProjectGraph", fn: FuncInfo, refs: Dict[str, str],
+                     node: ast.Call, depth: int,
+                     seen: Set[int]) -> List[RefEvent]:
+    """Inlined events for one bare-name call passing tracked refs."""
+    for callee in g.resolve_bare(fn, fn.file.rel, node.func.id):
+        cur = callee.parent  # nested defs are already in fn's full walk
+        nested = False
+        while cur is not None:
+            if cur is fn:
+                nested = True
+                break
+            cur = cur.parent
+        if nested or callee.node.args.vararg is not None \
+                or id(callee) in seen:
+            continue
+        params = [a.arg for a in callee.node.args.posonlyargs
+                  + callee.node.args.args]
+        sub: Dict[str, str] = {}
+        for i, a in enumerate(node.args):
+            if isinstance(a, ast.Starred):
+                sub = {}
+                break
+            if isinstance(a, ast.Name) and a.id in refs and i < len(params):
+                sub[params[i]] = refs[a.id]
+        for kw in node.keywords:
+            if kw.arg is not None and isinstance(kw.value, ast.Name) \
+                    and kw.value.id in refs:
+                sub[kw.arg] = refs[kw.value.id]
+        if sub:
+            return _ref_events_scan(g, callee, sub, depth + 1,
+                                    seen | {id(callee)})
+    return []
+
 
 def graph_for(project, files: Sequence, key: str) -> ProjectGraph:
     """Build (or fetch the cached) engine over ``files``; the cache lives

@@ -18,6 +18,11 @@ interprocedural rules landed):
 - :mod:`.tracer`    — ``tracer-leak`` (python control flow on traced values)
 - :mod:`.metricname` — ``metric-name`` (Prometheus family hygiene:
   sanitize-ambiguous names, one family under two types)
+- :mod:`.kernels`   — the Pallas kernel contract (ISSUE 19):
+  ``pallas-interpret-thread``, ``aliased-ref-read`` (on the engine's
+  per-kernel-body ref dataflow), ``recompile-hazard``
+- :mod:`.knobs`     — ``knob-contract`` (every ``tpu_*`` knob keeps its
+  validation / auto-resolution / bisect-harness / README legs)
 """
 from ..astutil import (  # noqa: F401  (re-exported for rule authors/tests)
     canonical_call,
@@ -27,6 +32,8 @@ from ..astutil import (  # noqa: F401  (re-exported for rule authors/tests)
 from . import (  # noqa: F401
     dtypes,
     hostsync,
+    kernels,
+    knobs,
     metricname,
     structure,
     threads,

@@ -1,0 +1,465 @@
+"""Pallas kernel-contract rules (ISSUE 19 tentpole).
+
+PR 17 hand-fixed two *latent* kernel bugs that every existing rule
+missed: a ``pallas_call`` that never threaded ``interpret=`` (so the
+CPU parity oracle silently compiled for a backend it could not have),
+and an RMW drain tile that read an ``input_output_aliases``-aliased
+input ref after the output had been written — correct on TPU where the
+alias is in-place, stale under the interpreter where input and output
+are distinct buffers. Both bug shapes are now rules, plus the
+zero-recompile invariant the whole perf program rests on:
+
+- :class:`PallasInterpretThreadRule` — ``interpret=`` must be present
+  and must dataflow from a parameter or config, never a literal;
+- :class:`AliasedRefReadRule` — no input-ref read after the first
+  aliased-output write, on the engine's new per-kernel-body ref
+  dataflow (:meth:`~..graph.ProjectGraph.ref_events`);
+- :class:`RecompileHazardRule` — host-dynamic values (``.item()``,
+  ``int()`` of traced arrays, ``np.asarray`` of device arrays) flowing
+  into shape positions (``jnp.zeros``/``reshape``, ``grid=``,
+  ``BlockSpec``, ``lax.dynamic_slice`` sizes) in the hot modules.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from ..astutil import canonical_call, dotted, own_walk_cached
+from ..core import Finding, Project, Rule, SourceFile, register
+from ..graph import PARTIAL_HEADS, FuncInfo, ProjectGraph, graph_for
+from .hostsync import HostSyncRule, hot_subset
+
+_PKG = "lightgbm_tpu/"
+
+
+def _is_pallas_call(node: ast.Call) -> bool:
+    return dotted(node.func).rsplit(".", 1)[-1] == "pallas_call"
+
+
+def _pkg_subset(project: Project):
+    return [f for f in project.files
+            if f.tree is not None and f.rel.startswith(_PKG)]
+
+
+# ---------------------------------------------------------------------------
+# pallas-interpret-thread
+# ---------------------------------------------------------------------------
+
+@register
+class PallasInterpretThreadRule(Rule):
+    """Every ``pl.pallas_call`` in ``lightgbm_tpu/`` must receive an
+    ``interpret=`` kwarg that dataflows from a caller parameter or a
+    config binding — never omitted (the call silently picks the compiled
+    path and the CPU parity oracle stops covering the kernel, PR 17 bug
+    #1) and never a literal (a hardwired ``interpret=False`` pins the
+    kernel to Mosaic on hosts that do not have it). Perf-harness scripts
+    under ``scripts/`` stay free to hardwire the mode."""
+
+    id = "pallas-interpret-thread"
+    description = ("pl.pallas_call in lightgbm_tpu/ must thread "
+                   "interpret= from a parameter or config, not omit it "
+                   "or pass a literal")
+
+    def check_file(self, f: SourceFile) -> Iterator[Finding]:
+        if not f.rel.startswith(_PKG) or f.tree is None:
+            return
+        yield from self._visit(f, f.tree, [])
+
+    def _visit(self, f: SourceFile, node: ast.AST,
+               fstack: List[ast.AST]) -> Iterator[Finding]:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._visit(f, child, fstack + [child])
+                continue
+            if isinstance(child, ast.Call) and _is_pallas_call(child):
+                yield from self._check_call(f, child, fstack)
+            yield from self._visit(f, child, fstack)
+
+    def _check_call(self, f: SourceFile, node: ast.Call,
+                    fstack: List[ast.AST]) -> Iterator[Finding]:
+        kw = next((k for k in node.keywords if k.arg == "interpret"), None)
+        if kw is None:
+            # a **kwargs splat may carry interpret= — can't see through it
+            if any(k.arg is None for k in node.keywords):
+                return
+            yield f.finding(
+                node, self.id,
+                "pallas_call without interpret=: the kernel always "
+                "compiles and the CPU parity oracle never covers it "
+                "(thread a parameter or a config flag)")
+            return
+        try:
+            ast.literal_eval(kw.value)
+        except (ValueError, SyntaxError):
+            pass
+        else:
+            yield f.finding(
+                kw.value, self.id,
+                "interpret= is a literal: thread it from a caller "
+                "parameter or config so the parity oracle can flip it")
+            return
+        if isinstance(kw.value, ast.Name):
+            yield from self._check_name(f, kw.value, fstack)
+
+    def _check_name(self, f: SourceFile, name: ast.Name,
+                    fstack: List[ast.AST]) -> Iterator[Finding]:
+        for fn in fstack:
+            a = fn.args
+            params = {p.arg for p in a.posonlyargs + a.args + a.kwonlyargs}
+            if name.id in params:
+                return  # threads from a caller parameter
+        assigns: List[ast.AST] = []
+        for scope in [f.tree] + fstack:
+            for n in own_walk_cached(scope):
+                if isinstance(n, ast.Assign):
+                    if any(isinstance(t, ast.Name) and t.id == name.id
+                           for t in n.targets):
+                        assigns.append(n.value)
+                elif isinstance(n, ast.AnnAssign) and n.value is not None \
+                        and isinstance(n.target, ast.Name) \
+                        and n.target.id == name.id:
+                    assigns.append(n.value)
+        if not assigns:
+            return  # imported config (e.g. ``from .partition import _INTERPRET``)
+        literal = True
+        for v in assigns:
+            try:
+                ast.literal_eval(v)
+            except (ValueError, SyntaxError):
+                literal = False
+                break
+        if literal:
+            yield f.finding(
+                name, self.id,
+                "interpret=%s is bound only to literals — a laundered "
+                "constant; thread it from a parameter or config" % name.id)
+
+
+# ---------------------------------------------------------------------------
+# aliased-ref-read
+# ---------------------------------------------------------------------------
+
+@register
+class AliasedRefReadRule(Rule):
+    """With ``input_output_aliases={i: j}`` the aliased input and output
+    are ONE buffer on TPU but TWO buffers under ``interpret=True`` — so
+    a kernel body that reads input ref *i* after the first write to
+    output ref *j* sees fresh data compiled and stale data interpreted
+    (PR 17 bug #2: the RMW drain tile read ``work_in`` where it had to
+    re-read ``work_ref``). Events come from the engine's per-kernel-body
+    ref dataflow; reads of regions the output never wrote (a different
+    leading plane) stay legal."""
+
+    id = "aliased-ref-read"
+    description = ("kernel reads an input_output_aliases input ref "
+                   "after the aliased output was written (stale under "
+                   "interpret=True)")
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        files = _pkg_subset(project)
+        if not files:
+            return
+        g = graph_for(project, files, "pkg")
+        scopes = []  # (owner FuncInfo or None, call nodes, SourceFile)
+        for f in files:
+            scopes.append((None, [n for n in own_walk_cached(f.tree)
+                                  if isinstance(n, ast.Call)], f))
+        for fn in g.funcs:
+            scopes.append((fn, g._fn_facts[id(fn)][3], fn.file))
+        for owner, calls, f in scopes:
+            for node in calls:
+                if not isinstance(node.func, ast.Call) \
+                        or not _is_pallas_call(node.func):
+                    continue
+                yield from self._check_site(g, owner, f, node)
+
+    def _check_site(self, g: ProjectGraph, owner: Optional[FuncInfo],
+                    f, outer: ast.Call) -> Iterator[Finding]:
+        inner = outer.func
+        aliases_kw = next((k.value for k in inner.keywords
+                           if k.arg == "input_output_aliases"), None)
+        if not isinstance(aliases_kw, ast.Dict):
+            return
+        pairs: List[Tuple[int, int]] = []
+        for k, v in zip(aliases_kw.keys, aliases_kw.values):
+            if isinstance(k, ast.Constant) and isinstance(k.value, int) \
+                    and isinstance(v, ast.Constant) \
+                    and isinstance(v.value, int):
+                pairs.append((k.value, v.value))
+        if not pairs or not inner.args:
+            return
+        if any(isinstance(a, ast.Starred) for a in outer.args):
+            return
+        resolved = self._resolve_kernel(g, owner, f, inner.args[0])
+        if resolved is None:
+            return
+        kern, offset = resolved
+        if kern.node.args.vararg is not None:
+            return  # runtime-dependent unpacking: not analyzable
+        params = [a.arg for a in kern.node.args.posonlyargs
+                  + kern.node.args.args][offset:]
+        num_inputs = len(outer.args)
+        for i, j in pairs:
+            if i >= num_inputs or num_inputs + j >= len(params):
+                continue
+            in_p, out_p = params[i], params[num_inputs + j]
+            events = g.ref_events(kern, {in_p: in_p, out_p: out_p})
+            written = False
+            labels: Set[Optional[str]] = set()
+            for ev in events:
+                if ev.ref == out_p and ev.kind == "write":
+                    written = True
+                    labels.add(ev.label)
+                elif ev.ref == in_p and ev.kind == "read" and written \
+                        and (ev.label is None or None in labels
+                             or ev.label in labels):
+                    yield ev.file.finding(
+                        ev.node, self.id,
+                        "kernel '%s' reads aliased input ref '%s' after "
+                        "writing aliased output ref '%s' "
+                        "(input_output_aliases={%d: %d} at %s:%d) — "
+                        "stale under interpret=True; re-read through "
+                        "'%s'" % (kern.qual, in_p, out_p, i, j, f.rel,
+                                  inner.lineno, out_p))
+                    break
+
+    @staticmethod
+    def _resolve_kernel(g: ProjectGraph, owner: Optional[FuncInfo], f,
+                        expr: ast.AST) -> Optional[Tuple[FuncInfo, int]]:
+        """``pallas_call``'s first argument to a (FuncInfo, positional
+        offset): a bare function name, a ``partial(fn, ...)`` call, or a
+        local bound to either. The offset counts positional args a
+        partial pre-binds (they shift the ref parameters right)."""
+        for _hop in range(3):
+            if isinstance(expr, ast.Call):
+                cname = dotted(expr.func)
+                if not (cname in PARTIAL_HEADS
+                        or cname.endswith(".partial")) or not expr.args:
+                    return None
+                offset = len(expr.args) - 1
+                expr = expr.args[0]
+                if isinstance(expr, ast.Name):
+                    fns = g.resolve_bare(owner, f.rel, expr.id)
+                    return (fns[0], offset) if fns else None
+                return None
+            if isinstance(expr, ast.Name):
+                bound = None
+                if owner is not None:
+                    for names, value in g._fn_facts[id(owner)][0]:
+                        if expr.id in names:
+                            bound = value
+                if bound is not None:
+                    expr = bound
+                    continue
+                fns = g.resolve_bare(owner, f.rel, expr.id)
+                return (fns[0], 0) if fns else None
+            return None
+        return None
+
+
+# ---------------------------------------------------------------------------
+# recompile-hazard
+# ---------------------------------------------------------------------------
+
+#: jnp constructors whose first argument (or shape=) is a shape
+_SHAPE_CTORS = {"zeros", "ones", "full", "empty", "arange",
+                "broadcast_to", "tile", "reshape"}
+#: sources that materialize a host Python value from device data
+_NP_SINKS = {"numpy.asarray", "numpy.array", "numpy.ascontiguousarray"}
+
+
+@register
+class RecompileHazardRule(Rule):
+    """The perf program's zero-recompile invariant — planes packing,
+    the one-kernel split, GOSS compaction and the MXU histograms all
+    assume *the same shapes every iteration* — dies silently when a
+    host-dynamic value (``.item()``, ``int()`` of a traced array,
+    ``np.asarray`` of a device array) flows into a shape position:
+    every new value retraces and recompiles the jit. The taint runs
+    through local assignments (in source order, so rebinding to a
+    static value clears it), into nested defs that close over tainted
+    names, and interprocedurally into helpers that receive a tainted
+    argument; sinks are ``jnp.zeros``/``reshape``-family shapes,
+    ``grid=``, ``BlockSpec``/``ShapeDtypeStruct`` shapes, and
+    ``lax.dynamic_slice`` / ``pl.ds`` *sizes* (dynamic starts stay
+    legal — that is what ``dynamic_slice`` is for)."""
+
+    id = "recompile-hazard"
+    description = ("host-dynamic value (.item()/int()/np.asarray of "
+                   "device data) flows into a shape position "
+                   "(jnp.zeros/reshape, grid=, BlockSpec, "
+                   "dynamic_slice sizes) — retraces every iteration")
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        hot_files = hot_subset(project)
+        if not hot_files:
+            return
+        g = graph_for(project, hot_files, "hot")
+        self._seen_sites: Set[Tuple[str, int, int]] = set()
+        self._seen_scans: Set[Tuple[int, Tuple[str, ...]]] = set()
+        work: List[Tuple[FuncInfo, Dict[str, str]]] = \
+            [(fn, {}) for fn in g.funcs if fn.parent is None]
+        while work:
+            fn, taint = work.pop()
+            key = (id(fn), tuple(sorted(taint)))
+            if key in self._seen_scans:
+                continue
+            self._seen_scans.add(key)
+            yield from self._scan_fn(g, fn, taint, work)
+
+    # ------------------------------------------------------------- taint scan
+    def _scan_fn(self, g: ProjectGraph, fn: FuncInfo,
+                 taint: Dict[str, str],
+                 work: List[Tuple[FuncInfo, Dict[str, str]]]
+                 ) -> Iterator[Finding]:
+        aliases = g.aliases[fn.file.rel]
+        taint = dict(taint)
+        stmts = [n for n in own_walk_cached(fn.node)
+                 if isinstance(n, (ast.Assign, ast.AnnAssign, ast.Call))]
+        stmts.sort(key=lambda n: (n.lineno, n.col_offset))
+        for node in stmts:
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                names = [t.id for t in targets if isinstance(t, ast.Name)]
+                if not names or node.value is None:
+                    continue
+                src = self._dyn_source(node.value, aliases) \
+                    or self._tainted_name(node.value, taint)
+                for n in names:
+                    if src is not None:
+                        taint[n] = src
+                    else:
+                        taint.pop(n, None)
+            else:
+                yield from self._check_sinks(fn, node, taint, aliases)
+                self._propagate_call(g, fn, node, taint, work)
+        # nested defs close over the enclosing taint (minus shadowed params)
+        for group in fn.children.values():
+            for child in group:
+                a = child.node.args
+                shadow = {p.arg for p in a.posonlyargs + a.args
+                          + a.kwonlyargs}
+                inherited = {k: v for k, v in taint.items()
+                             if k not in shadow}
+                work.append((child, inherited))
+
+    @staticmethod
+    def _tainted_name(expr: ast.AST, taint: Dict[str, str]
+                      ) -> Optional[str]:
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Name) and n.id in taint:
+                return taint[n.id]
+        return None
+
+    @staticmethod
+    def _dyn_source(expr: ast.AST, aliases: Dict[str, str]
+                    ) -> Optional[str]:
+        arrayish = HostSyncRule._arg_is_arrayish
+        for n in ast.walk(expr):
+            if not isinstance(n, ast.Call):
+                continue
+            if isinstance(n.func, ast.Attribute) \
+                    and n.func.attr in ("item", "tolist") \
+                    and not n.args and not n.keywords:
+                return ".%s()" % n.func.attr
+            cname = canonical_call(n, aliases)
+            if cname in ("int", "float", "len") and n.args \
+                    and arrayish(n.args[0], aliases):
+                return "%s() of a traced value" % cname
+            if cname in _NP_SINKS and n.args \
+                    and arrayish(n.args[0], aliases):
+                return "%s() of a device array" % dotted(n.func)
+            if cname == "jax.device_get":
+                return "jax.device_get()"
+        return None
+
+    # ---------------------------------------------------------------- sinks
+    def _check_sinks(self, fn: FuncInfo, node: ast.Call,
+                     taint: Dict[str, str],
+                     aliases: Dict[str, str]) -> Iterator[Finding]:
+        if not taint:
+            return
+        cname = canonical_call(node, aliases)
+        tail = cname.rsplit(".", 1)[-1]
+        shape_args: List[ast.AST] = []
+        sink = None
+        if cname.startswith("jax.numpy.") and tail in _SHAPE_CTORS:
+            if tail in ("reshape", "arange"):
+                shape_args = list(node.args)
+            elif node.args:
+                shape_args = [node.args[0]]
+            shape_args += [k.value for k in node.keywords
+                           if k.arg == "shape"]
+            sink = "%s(...) shape" % dotted(node.func)
+        elif isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "reshape":
+            shape_args = list(node.args)
+            sink = ".reshape(...) shape"
+        elif tail == "BlockSpec":
+            shape_args = list(node.args) \
+                + [k.value for k in node.keywords
+                   if k.arg == "block_shape"]
+            sink = "BlockSpec block shape"
+        elif tail == "ShapeDtypeStruct":
+            shape_args = node.args[:1] \
+                + [k.value for k in node.keywords if k.arg == "shape"]
+            sink = "ShapeDtypeStruct shape"
+        elif cname.endswith(".dynamic_slice"):
+            shape_args = node.args[2:3]
+            sink = "dynamic_slice sizes"
+        elif cname.endswith(".dynamic_slice_in_dim"):
+            shape_args = node.args[2:3] \
+                + [k.value for k in node.keywords
+                   if k.arg == "slice_size"]
+            sink = "dynamic_slice_in_dim slice_size"
+        elif tail == "ds" and len(node.args) >= 2:
+            shape_args = [node.args[1]]
+            sink = "pl.ds window size"
+        grid_kws = [k.value for k in node.keywords if k.arg == "grid"]
+        for val, label in [(a, sink) for a in shape_args] \
+                + [(kwv, "grid=") for kwv in grid_kws]:
+            src = self._tainted_name(val, taint)
+            if src is None:
+                continue
+            site = (fn.file.rel, node.lineno, node.col_offset)
+            if site in self._seen_sites:
+                return
+            self._seen_sites.add(site)
+            yield fn.file.finding(
+                node, self.id,
+                "host-dynamic value (%s) flows into %s in '%s' — the "
+                "shape changes between iterations and every change "
+                "retraces + recompiles the jit" % (src, label, fn.qual))
+            return
+
+    # ---------------------------------------------------- interprocedural
+    @staticmethod
+    def _propagate_call(g: ProjectGraph, fn: FuncInfo, node: ast.Call,
+                        taint: Dict[str, str],
+                        work: List[Tuple[FuncInfo, Dict[str, str]]]
+                        ) -> None:
+        if not taint or not isinstance(node.func, ast.Name):
+            return
+        for callee in g.resolve_bare(fn, fn.file.rel, node.func.id):
+            if callee.node.args.vararg is not None:
+                continue
+            params = [a.arg for a in callee.node.args.posonlyargs
+                      + callee.node.args.args]
+            sub: Dict[str, str] = {}
+            for i, a in enumerate(node.args):
+                if isinstance(a, ast.Starred):
+                    sub = {}
+                    break
+                src = RecompileHazardRule._tainted_name(a, taint)
+                if src is not None and i < len(params):
+                    sub[params[i]] = src
+            for kw in node.keywords:
+                if kw.arg is None:
+                    continue
+                src = RecompileHazardRule._tainted_name(kw.value, taint)
+                if src is not None:
+                    sub[kw.arg] = src
+            if sub:
+                work.append((callee, sub))
+            break

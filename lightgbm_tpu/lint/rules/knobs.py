@@ -1,0 +1,209 @@
+"""knob-contract: every ``tpu_*`` knob ships its full support surface.
+
+The auto-knob program (ROADMAP: every ``tpu_*`` knob is auto-resolved,
+telemetry-recorded and hardware-bisectable) only works while each knob
+keeps four legs attached:
+
+1. a **validation clause** in ``config.py`` (``_check`` rejects values
+   outside the enum/range — the run ledger's preresolution path replays
+   knob values from disk, so unvalidated knobs are an injection seam);
+2. an **auto-resolution site** that records the resolved value *with a
+   reason string* (``telemetry.record("auto_resolution", ...)`` — the
+   reason is what makes a bisect against the ledger actionable);
+3. a ``scripts/*_bisect.py`` **harness** that can measure the knob on
+   hardware (auto defaults stay "off until the bisect validates it");
+4. a **README row** documenting the knob.
+
+Boolean knobs are exempt from (1) (the type is the enum); legs (2) and
+(3) apply to *auto* knobs — default ``"auto"`` or resolved through a
+recorded auto-resolution. The rule reads sibling files from disk when
+they are outside the linted subset (``--changed`` runs), so a partial
+lint never reports a leg as missing just because it was not linted.
+"""
+from __future__ import annotations
+
+import ast
+import glob
+import os
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from ..core import Finding, Project, Rule, register
+
+_CONFIG_REL = "lightgbm_tpu/config.py"
+
+
+def _class_level_knobs(tree: ast.Module) -> List[Tuple[str, ast.AST, int]]:
+    out: List[Tuple[str, ast.AST, int]] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        for stmt in node.body:
+            if isinstance(stmt, ast.AnnAssign) \
+                    and isinstance(stmt.target, ast.Name) \
+                    and stmt.target.id.startswith("tpu_"):
+                out.append((stmt.target.id, stmt.value, stmt.lineno))
+            elif isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name) and t.id.startswith("tpu_"):
+                        out.append((t.id, stmt.value, stmt.lineno))
+    return out
+
+
+def _package_trees(project: Project) -> Iterator[ast.Module]:
+    """ASTs of every ``lightgbm_tpu/*.py`` — parsed files from the lint
+    run where available, read from disk otherwise (``--changed``)."""
+    seen: Set[str] = set()
+    for f in project.files:
+        if f.rel.startswith("lightgbm_tpu/") and f.tree is not None:
+            seen.add(f.rel)
+            yield f.tree
+    pkg_root = os.path.join(project.root, "lightgbm_tpu")
+    for dirpath, dirnames, filenames in os.walk(pkg_root):
+        dirnames[:] = [d for d in dirnames if not d.startswith((".", "__"))]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            ap = os.path.join(dirpath, fn)
+            rel = os.path.relpath(ap, project.root).replace(os.sep, "/")
+            if rel in seen:
+                continue
+            try:
+                with open(ap, "r", encoding="utf-8",
+                          errors="replace") as fh:
+                    yield ast.parse(fh.read(), filename=rel)
+            except (OSError, SyntaxError):
+                continue
+
+
+def _bisect_sources(project: Project) -> Iterator[str]:
+    seen: Set[str] = set()
+    for f in project.files:
+        if f.rel.startswith("scripts/") and f.rel.endswith("_bisect.py"):
+            seen.add(f.rel)
+            yield f.source
+    for ap in sorted(glob.glob(os.path.join(project.root, "scripts",
+                                            "*_bisect.py"))):
+        rel = os.path.relpath(ap, project.root).replace(os.sep, "/")
+        if rel in seen:
+            continue
+        try:
+            with open(ap, "r", encoding="utf-8", errors="replace") as fh:
+                yield fh.read()
+        except OSError:
+            continue
+
+
+def _resolution_sites(tree: ast.Module) -> Dict[str, bool]:
+    """knob name -> "records a non-empty reason" for every
+    auto-resolution site in one module: direct
+    ``telemetry.record("auto_resolution", ..., knob=..., reason=...)``
+    calls plus calls through local recorder helpers (the learner's
+    ``_rec(knob, value, reason)`` pattern) whose body does the record."""
+    recorders: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call) \
+                    and isinstance(n.func, ast.Attribute) \
+                    and n.func.attr == "record" and n.args \
+                    and isinstance(n.args[0], ast.Constant) \
+                    and n.args[0].value == "auto_resolution":
+                recorders.add(node.name)
+                break
+    out: Dict[str, bool] = {}
+
+    def reason_ok(expr: Optional[ast.AST]) -> bool:
+        if expr is None:
+            return False
+        if isinstance(expr, ast.Constant):
+            return bool(expr.value)
+        return True  # dynamically built reason: trust it
+
+    def note(knob: Optional[ast.AST], reason: Optional[ast.AST]) -> None:
+        if isinstance(knob, ast.Constant) and isinstance(knob.value, str):
+            out[knob.value] = out.get(knob.value, False) or reason_ok(reason)
+
+    for n in ast.walk(tree):
+        if not isinstance(n, ast.Call):
+            continue
+        kws = {k.arg: k.value for k in n.keywords if k.arg is not None}
+        if isinstance(n.func, ast.Name) and n.func.id in recorders:
+            note(n.args[0] if n.args else None,
+                 n.args[2] if len(n.args) >= 3 else kws.get("reason"))
+        elif isinstance(n.func, ast.Attribute) and n.func.attr == "record" \
+                and n.args and isinstance(n.args[0], ast.Constant) \
+                and n.args[0].value == "auto_resolution":
+            note(kws.get("knob"), kws.get("reason"))
+    return out
+
+
+@register
+class KnobContractRule(Rule):
+    """Cross-file contract check over the ``tpu_*`` knob surface (see
+    module docstring for the four legs)."""
+
+    id = "knob-contract"
+    description = ("every tpu_* knob in config.py needs a validation "
+                   "clause, a README row, and (for auto knobs) a "
+                   "reasoned auto-resolution site plus a "
+                   "scripts/*_bisect.py harness")
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        cfg = project.by_rel(_CONFIG_REL)
+        if cfg is None or cfg.tree is None:
+            return
+        knobs = _class_level_knobs(cfg.tree)
+        if not knobs:
+            return
+
+        cfg_attr_refs = {n.attr for n in cfg.walk_nodes()
+                         if isinstance(n, ast.Attribute)}
+        resolved: Dict[str, bool] = {}
+        for tree in _package_trees(project):
+            for knob, ok in _resolution_sites(tree).items():
+                resolved[knob] = resolved.get(knob, False) or ok
+        bisect_text = "\n".join(_bisect_sources(project))
+        readme_text: Optional[str] = None
+        readme_path = os.path.join(project.root, "README.md")
+        if os.path.exists(readme_path):
+            with open(readme_path, "r", encoding="utf-8",
+                      errors="replace") as fh:
+                readme_text = fh.read()
+
+        for knob, default, lineno in knobs:
+            is_bool = isinstance(default, ast.Constant) \
+                and isinstance(default.value, bool)
+            is_auto = (isinstance(default, ast.Constant)
+                       and default.value == "auto") or knob in resolved
+            if not is_bool and knob not in cfg_attr_refs:
+                yield cfg.finding(
+                    lineno, self.id,
+                    "%s has no validation clause in config.py — _check "
+                    "must reject out-of-range values (the run-ledger "
+                    "preresolution path replays knobs from disk)" % knob)
+            if readme_text is not None and knob not in readme_text:
+                yield cfg.finding(
+                    lineno, self.id,
+                    "%s has no README row — every tpu_* knob is "
+                    "documented in the knob table" % knob)
+            if is_auto:
+                if knob not in resolved:
+                    yield cfg.finding(
+                        lineno, self.id,
+                        "auto knob %s has no auto-resolution site "
+                        "recording telemetry('auto_resolution', ...) "
+                        "with a reason" % knob)
+                elif not resolved[knob]:
+                    yield cfg.finding(
+                        lineno, self.id,
+                        "auto knob %s's auto-resolution site records "
+                        "no reason string — unreasoned resolutions "
+                        "make ledger bisects unactionable" % knob)
+                if knob not in bisect_text:
+                    yield cfg.finding(
+                        lineno, self.id,
+                        "auto knob %s has no scripts/*_bisect.py "
+                        "harness mentioning it — auto defaults stay "
+                        "off until a bisect validates them on "
+                        "hardware" % knob)

@@ -423,6 +423,7 @@ def hist_pallas_segment(work: jax.Array, plane, start, cnt, *,
     )
     scalars = jnp.stack([plane.astype(jnp.int32), start.astype(jnp.int32),
                          cnt.astype(jnp.int32)])
+    from .partition import _INTERPRET
     work_out, acc = pl.pallas_call(
         kern,
         name="hist_pallas_segment",
@@ -430,6 +431,7 @@ def hist_pallas_segment(work: jax.Array, plane, start, cnt, *,
         out_shape=[jax.ShapeDtypeStruct(work.shape, work.dtype),
                    jax.ShapeDtypeStruct((f * sh, lo_w * nch), jnp.float32)],
         input_output_aliases={1: 0},
+        interpret=_INTERPRET,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",),
             vmem_limit_bytes=100 * 1024 * 1024),

@@ -110,11 +110,13 @@ def route_rows(bins_t: jax.Array, table: jax.Array, num_splits: jax.Array,
                                lambda i, s: (0, i, 0))],
         out_specs=pl.BlockSpec((csub, 128), lambda i, s: (i, 0)),
     )
+    from .partition import _INTERPRET
     out = pl.pallas_call(
         kern,
         name="route_rows",
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((nsub, 128), jnp.int32),
+        interpret=_INTERPRET,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",)),
     )(scalars, bins_t)

@@ -7,6 +7,11 @@
 #                       and forest-kernel parity trains run under the
 #                       pallas interpreter)
 #   check.sh --fast     lint only files changed vs git + lint tests
+#
+# Every mode (including --fast) fails on baseline drift: lint.py exits
+# nonzero on net-new findings AND on stale lint_baseline.json entries
+# (a frozen finding whose source line no longer exists — the baseline
+# must shrink monotonically; run scripts/lint.py --update-baseline).
 #   check.sh --fleet    lint + lint tests + the fleet/online/serve fast
 #                       subset (durability/fairness/rollback plus the
 #                       failover/compaction/transport hardening tests

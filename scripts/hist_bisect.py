@@ -1,4 +1,10 @@
-"""Device-profiled bisect of the pallas hist kernel's per-chunk cost."""
+"""Device-profiled bisect of the pallas hist kernel's per-chunk cost.
+
+The hardware harness behind the ``tpu_hist_kernel`` (pallas vs xla
+segment histograms) and ``tpu_hist_chunk`` (rows per segment-histogram
+launch) auto knobs: their learner defaults are the chunk/kernel points
+this bisect measured on v5e.
+"""
 import collections
 import glob
 import gzip

@@ -9,10 +9,13 @@ Usage:
     python scripts/lint.py --changed            # only files dirty vs HEAD
     python scripts/lint.py lightgbm_tpu/ops     # restrict paths
 
-Exit status: 0 when every finding is baselined or suppressed, 1 on new
-findings, 2 on usage errors (unknown/empty --rules, --changed without
-git). Pure stdlib — no jax import; a full-repo run stays well under the
-tier-1 ~5 s budget (tests/test_lint.py enforces it).
+Exit status: 0 when every finding is baselined or suppressed AND no
+baseline entry went stale, 1 on new findings or baseline drift (a frozen
+entry whose source line no longer exists — fix the baseline, it must
+shrink monotonically), 2 on usage errors (unknown/empty --rules,
+--changed without git, --update-baseline with --changed). Pure stdlib —
+no jax import; a full-repo run stays well under the tier-1 ~5 s budget
+(tests/test_lint.py enforces it).
 """
 import argparse
 import importlib.machinery
@@ -22,8 +25,12 @@ import os
 import subprocess
 import sys
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-sys.path.insert(0, REPO)
+_SRC = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# the linted tree defaults to this repo; tests point LGBTPU_LINT_ROOT at
+# a fixture tree to drive the full CLI (baseline drift, exit codes)
+# hermetically while the lint package still imports from here
+REPO = os.environ.get("LGBTPU_LINT_ROOT", _SRC)
+sys.path.insert(0, _SRC)
 
 # lightgbm_tpu.lint is pure stdlib, but importing it through the real
 # parent package would execute lightgbm_tpu/__init__.py — which pulls in
@@ -32,7 +39,7 @@ sys.path.insert(0, REPO)
 if "lightgbm_tpu" not in sys.modules:
     _spec = importlib.machinery.ModuleSpec("lightgbm_tpu", None,
                                            is_package=True)
-    _spec.submodule_search_locations = [os.path.join(REPO, "lightgbm_tpu")]
+    _spec.submodule_search_locations = [os.path.join(_SRC, "lightgbm_tpu")]
     sys.modules["lightgbm_tpu"] = importlib.util.module_from_spec(_spec)
 
 from lightgbm_tpu import lint  # noqa: E402
@@ -80,7 +87,7 @@ def main(argv=None) -> int:
                     help="ignore the baseline; report every finding")
     ap.add_argument("--update-baseline", action="store_true",
                     help="rewrite the baseline to the current findings "
-                         "and exit 0")
+                         "(pruning stale entries) and exit 0")
     ap.add_argument("--rules", default=None,
                     help="comma-separated rule ids to run (default: all)")
     ap.add_argument("--changed", action="store_true",
@@ -108,43 +115,77 @@ def main(argv=None) -> int:
                   % ", ".join(unknown), file=sys.stderr)
             return 2
 
+    if args.update_baseline and args.changed:
+        print("graftlint: --update-baseline needs a full run — a "
+              "--changed subset would drop every entry outside it",
+              file=sys.stderr)
+        return 2
+
     paths = args.paths
     if args.changed:
         paths = _changed_paths(paths)
         if not paths:
+            # nothing to lint, but frozen entries can still have gone
+            # stale (a fix committed without shrinking the baseline)
+            stale = lint.stale_baseline_entries(
+                REPO, lint.load_baseline(args.baseline))
+            for e in stale:
+                print("graftlint: stale baseline entry %s [%s] %r"
+                      % (e.get("path"), e.get("rule"), e.get("text")))
+            if stale:
+                print("graftlint: %d stale baseline entr%s — run "
+                      "scripts/lint.py --update-baseline"
+                      % (len(stale), "y" if len(stale) == 1 else "ies"))
+                return 1
             print("graftlint: no changed files under the requested paths")
             return 0
 
     result = lint.run(REPO, paths, rules=rules)
 
     if args.update_baseline:
-        lint.save_baseline(args.baseline,
-                           lint.baseline_from_findings(result.findings))
-        print("baseline updated: %s (%d findings frozen)"
-              % (os.path.relpath(args.baseline, REPO), len(result.findings)))
+        old_baseline = lint.load_baseline(args.baseline)
+        new_baseline = lint.baseline_from_findings(result.findings)
+        kept = {(e["path"], e["rule"], e["text"])
+                for e in new_baseline["findings"]}
+        pruned = sum(1 for e in old_baseline.get("findings", [])
+                     if (e.get("path"), e.get("rule"), e.get("text"))
+                     not in kept)
+        lint.save_baseline(args.baseline, new_baseline)
+        print("baseline updated: %s (%d findings frozen, %d stale "
+              "entr%s pruned)"
+              % (os.path.relpath(args.baseline, REPO),
+                 len(result.findings), pruned,
+                 "y" if pruned == 1 else "ies"))
         return 0
 
+    stale = []
     if args.no_baseline:
         new, old = list(result.findings), []
     else:
         baseline = lint.load_baseline(args.baseline)
         new, old = lint.split_new_findings(result.findings, baseline)
+        stale = lint.stale_baseline_entries(REPO, baseline)
 
     if args.as_json:
         print(json.dumps({
             "new": [vars(f) for f in new],
             "baselined": [vars(f) for f in old],
             "suppressed": [vars(f) for f in result.suppressed],
+            "stale_baseline": stale,
             "files": len(result.project.files),
-            "ok": not new,
+            "ok": not new and not stale,
         }))
     else:
         for f in new:
             print(f.render())
+        for e in stale:
+            print("graftlint: stale baseline entry %s [%s] %r"
+                  % (e.get("path"), e.get("rule"), e.get("text")))
         print("graftlint: %d file(s), %d new finding(s), %d baselined, "
-              "%d suppressed" % (len(result.project.files), len(new),
-                                 len(old), len(result.suppressed)))
-    return 1 if new else 0
+              "%d suppressed, %d stale baseline"
+              % (len(result.project.files), len(new), len(old),
+                 len(result.suppressed), len(stale)))
+    return 1 if new or stale else 0
 
 
 if __name__ == "__main__":

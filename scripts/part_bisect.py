@@ -1,4 +1,9 @@
-"""Bisect the partition kernel's ~400us fixed cost: strip pieces, measure."""
+"""Bisect the partition kernel's ~400us fixed cost: strip pieces, measure.
+
+The hardware harness behind the ``tpu_part_chunk`` auto knob (rows per
+partition compaction launch): the 1024-pallas / 2048-xla defaults are
+the chunk points this bisect measured on v5e.
+"""
 import os
 import sys
 from functools import partial

@@ -920,4 +920,543 @@ def test_cli_changed_mode():
 
 def test_new_rules_registered():
     ids = set(lint.all_rules())
-    assert {"lock-discipline", "tracer-leak", "dtype-promotion"} <= ids
+    assert {"lock-discipline", "tracer-leak", "dtype-promotion",
+            "pallas-interpret-thread", "aliased-ref-read",
+            "recompile-hazard", "knob-contract"} <= ids
+
+
+# ------------------------------------------------- pallas-interpret-thread
+
+def test_interpret_thread_positive(tmp_path):
+    res = make_project(tmp_path, {
+        "lightgbm_tpu/ops/k.py": """\
+            import jax
+            from jax.experimental import pallas as pl
+
+            _FROZEN = False
+
+            def kern(x_ref, o_ref):
+                o_ref[...] = x_ref[...]
+
+            def launch_omitted(x):
+                return pl.pallas_call(
+                    kern, name="a",
+                    out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype))(x)
+
+            def launch_literal(x):
+                return pl.pallas_call(
+                    kern, name="b",
+                    out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+                    interpret=True)(x)
+
+            def launch_laundered(x):
+                return pl.pallas_call(
+                    kern, name="c",
+                    out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+                    interpret=_FROZEN)(x)
+        """})
+    assert len(lines_hit(res, "pallas-interpret-thread")) == 3
+
+
+def test_interpret_thread_negative(tmp_path):
+    res = make_project(tmp_path, {
+        "lightgbm_tpu/ops/cfg.py": """\
+            import os
+            _INTERPRET = os.environ.get("X", "") not in ("", "0")
+        """,
+        "lightgbm_tpu/ops/k.py": """\
+            import jax
+            from jax.experimental import pallas as pl
+            from .cfg import _INTERPRET
+
+            def kern(x_ref, o_ref):
+                o_ref[...] = x_ref[...]
+
+            def launch_param(x, interpret):
+                return pl.pallas_call(
+                    kern, name="a",
+                    out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+                    interpret=interpret)(x)
+
+            def launch_config(x):
+                return pl.pallas_call(
+                    kern, name="b",
+                    out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+                    interpret=_INTERPRET)(x)
+
+            def launch_expr(x):
+                return pl.pallas_call(
+                    kern, name="c",
+                    out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+                    interpret=jax.default_backend() != "tpu")(x)
+        """,
+        # perf-harness scripts stay free to hardwire the mode
+        "scripts/pallas_probe.py": """\
+            import jax
+            from jax.experimental import pallas as pl
+
+            def kern(x_ref, o_ref):
+                o_ref[...] = x_ref[...]
+
+            def launch(x):
+                return pl.pallas_call(
+                    kern, name="p", interpret=True,
+                    out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype))(x)
+        """})
+    assert "pallas-interpret-thread" not in rules_hit(res)
+
+
+def test_interpret_thread_suppression(tmp_path):
+    res = make_project(tmp_path, {
+        "lightgbm_tpu/ops/k.py": """\
+            import jax
+            from jax.experimental import pallas as pl
+
+            def kern(x_ref, o_ref):
+                o_ref[...] = x_ref[...]
+
+            def launch(x):
+                return pl.pallas_call(  # graftlint: disable=pallas-interpret-thread -- CPU-only helper
+                    kern, name="a",
+                    out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype))(x)
+        """})
+    assert "pallas-interpret-thread" not in rules_hit(res)
+    assert any(f.rule == "pallas-interpret-thread" for f in res.suppressed)
+
+
+# ------------------------------------------------------- aliased-ref-read
+
+def test_aliased_ref_read_positive(tmp_path):
+    res = make_project(tmp_path, {
+        "lightgbm_tpu/ops/k.py": """\
+            import jax
+            import jax.numpy as jnp
+            from jax.experimental import pallas as pl
+
+            def kern(x_ref, o_ref):
+                o_ref[0] = x_ref[0] * 2
+                stale = x_ref[0]
+                o_ref[1] = stale
+
+            def launch(x, interpret):
+                return pl.pallas_call(
+                    kern, name="a", input_output_aliases={0: 0},
+                    out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+                    interpret=interpret)(x)
+        """})
+    assert lines_hit(res, "aliased-ref-read") == [7]
+
+
+def test_aliased_ref_read_negative(tmp_path):
+    res = make_project(tmp_path, {
+        "lightgbm_tpu/ops/k.py": """\
+            import jax
+            import jax.numpy as jnp
+            from jax.experimental import pallas as pl
+            from jax.experimental.pallas import tpu as pltpu
+
+            def kern_read_first(x_ref, o_ref):
+                v = x_ref[0]
+                o_ref[0] = v * 2
+
+            def kern_other_region(sref, w_in, w_out, fb, sem):
+                dst = sref[0]
+                src = sref[1]
+                wr = pltpu.make_async_copy(
+                    fb.at[0], w_out.at[dst, pl.ds(0, 8), :], sem.at[0])
+                wr.wait()
+                rd = pltpu.make_async_copy(
+                    w_in.at[src, pl.ds(0, 8), :], fb.at[0], sem.at[1])
+                rd.wait()
+                rd2 = pltpu.make_async_copy(
+                    w_out.at[dst, pl.ds(0, 8), :], fb.at[0], sem.at[2])
+                rd2.wait()
+
+            def kern_varargs(sref, *refs):
+                refs[1][0] = 1
+                v = refs[0][0]
+
+            def launch(x, scalars, work, interpret):
+                a = pl.pallas_call(
+                    kern_read_first, name="a",
+                    input_output_aliases={0: 0},
+                    out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+                    interpret=interpret)(x)
+                b = pl.pallas_call(
+                    kern_other_region, name="b",
+                    input_output_aliases={1: 0},
+                    out_shape=[jax.ShapeDtypeStruct(work.shape, work.dtype)],
+                    interpret=interpret)(scalars, work)
+                c = pl.pallas_call(
+                    kern_varargs, name="c",
+                    input_output_aliases={1: 0},
+                    out_shape=[jax.ShapeDtypeStruct(work.shape, work.dtype)],
+                    interpret=interpret)(scalars, work)
+                return a, b, c
+        """})
+    assert "aliased-ref-read" not in rules_hit(res)
+
+
+def test_aliased_ref_read_interprocedural(tmp_path):
+    """The hazard hides in a helper the kernel hands its refs to — the
+    engine inlines the helper's ref events at the call site."""
+    res = make_project(tmp_path, {
+        "lightgbm_tpu/ops/k.py": """\
+            import jax
+            import jax.numpy as jnp
+            from jax.experimental import pallas as pl
+
+            def _drain(src, acc):
+                return src[0] + acc
+
+            def kern(x_ref, o_ref):
+                o_ref[0] = x_ref[0] * 2
+                acc = _drain(x_ref, 0)
+                o_ref[1] = acc
+
+            def launch(x, interpret):
+                return pl.pallas_call(
+                    kern, name="a", input_output_aliases={0: 0},
+                    out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+                    interpret=interpret)(x)
+        """})
+    assert lines_hit(res, "aliased-ref-read") == [6]
+
+
+def test_aliased_ref_read_suppression(tmp_path):
+    res = make_project(tmp_path, {
+        "lightgbm_tpu/ops/k.py": """\
+            import jax
+            import jax.numpy as jnp
+            from jax.experimental import pallas as pl
+
+            def kern(x_ref, o_ref):
+                o_ref[0] = x_ref[0] * 2
+                v = x_ref[0]  # graftlint: disable=aliased-ref-read -- proven tpu-only kernel
+                o_ref[1] = v
+
+            def launch(x, interpret):
+                return pl.pallas_call(
+                    kern, name="a", input_output_aliases={0: 0},
+                    out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+                    interpret=interpret)(x)
+        """})
+    assert "aliased-ref-read" not in rules_hit(res)
+    assert any(f.rule == "aliased-ref-read" for f in res.suppressed)
+
+
+# ------------------------------------------------------ PR 17 regressions
+
+def test_pr17_bugs_verbatim_regression(tmp_path):
+    """Both PR 17 latent bugs, re-introduced verbatim (the pre-fix
+    ``partition_segment_fused`` pallas_call with no ``interpret=`` and
+    the RMW drain tile reading ``work_in`` where only ``work_ref`` holds
+    the freshly-written rows): each must be caught by its rule."""
+    res = make_project(tmp_path, {
+        "lightgbm_tpu/ops/partition.py": """\
+            from functools import partial
+            import jax
+            import jax.numpy as jnp
+            from jax.experimental import pallas as pl
+            from jax.experimental.pallas import tpu as pltpu
+
+            def _partition_kernel(sref, work_in, work_ref, lt_ref, tril,
+                                  cin, pre, lstage, rstage, lfb, rfb, sem,
+                                  *, ch, sb, width, num_bin):
+                dst_plane = 1 - sref[0]
+                dstart = sref[1]
+                d = sref[2]
+                wr = pltpu.make_async_copy(
+                    lfb.at[0], work_ref.at[dst_plane, pl.ds(dstart, ch), :],
+                    sem.at[3])
+                wr.start()
+                wr.wait()
+                at = dstart + d - ch
+                rd = pltpu.make_async_copy(
+                    work_in.at[dst_plane, pl.ds(at, ch), :], lfb.at[0], sem.at[4])
+                rd.start()
+                rd.wait()
+                lt_ref[0] = d
+
+            def partition_segment_fused(work, scalars, ch, sb, width,
+                                        num_bin):
+                kern = partial(_partition_kernel, ch=ch, sb=sb, width=width,
+                               num_bin=num_bin)
+                grid_spec = pltpu.PrefetchScalarGridSpec(
+                    num_scalar_prefetch=1,
+                    grid=(1,),
+                    in_specs=[pl.BlockSpec(memory_space=pltpu.HBM)],
+                    out_specs=[pl.BlockSpec(memory_space=pltpu.HBM),
+                               pl.BlockSpec(memory_space=pltpu.SMEM)],
+                )
+                work_out, lt = pl.pallas_call(
+                    kern,
+                    name="partition_segment_fused",
+                    grid_spec=grid_spec,
+                    out_shape=[jax.ShapeDtypeStruct(work.shape, work.dtype),
+                               jax.ShapeDtypeStruct((1,), jnp.int32)],
+                    input_output_aliases={1: 0},
+                    compiler_params=pltpu.CompilerParams(
+                        dimension_semantics=("arbitrary",),
+                        vmem_limit_bytes=100 * 1024 * 1024),
+                )(scalars, work)
+                return work_out, lt[0]
+        """})
+    # bug #1: the pallas_call never threads interpret=
+    assert lines_hit(res, "pallas-interpret-thread") == [36]
+    # bug #2: the drain tile reads work_in after work_ref was written
+    assert lines_hit(res, "aliased-ref-read") == [20]
+
+
+# ------------------------------------------------------- recompile-hazard
+
+def test_recompile_hazard_positive(tmp_path):
+    res = make_project(tmp_path, {
+        "lightgbm_tpu/ops/dyn.py": """\
+            import jax
+            import jax.numpy as jnp
+
+            def grow(counts, work):
+                n = int(jnp.sum(counts))
+                buf = jnp.zeros((n, 4), jnp.float32)
+                sz = counts.item()
+                view = jax.lax.dynamic_slice_in_dim(work, 0, sz)
+                return buf, view
+        """})
+    assert lines_hit(res, "recompile-hazard") == [6, 8]
+
+
+def test_recompile_hazard_interprocedural(tmp_path):
+    """The tainted value crosses a call boundary; the sink is flagged in
+    the helper that builds the shape."""
+    res = make_project(tmp_path, {
+        "lightgbm_tpu/ops/dyn.py": """\
+            import jax.numpy as jnp
+
+            def helper(m):
+                return jnp.ones((m, 2), jnp.float32)
+
+            def via(x):
+                k = x.item()
+                return helper(k)
+        """})
+    assert lines_hit(res, "recompile-hazard") == [4]
+
+
+def test_recompile_hazard_negative(tmp_path):
+    res = make_project(tmp_path, {
+        "lightgbm_tpu/ops/dyn.py": """\
+            import jax.numpy as jnp
+
+            def static_shapes(work, cfg):
+                n = work.shape[0]
+                pad = (n + 127) // 128 * 128
+                return jnp.zeros((pad, 4), jnp.float32)
+
+            def rebound(x):
+                n = x.item()
+                n = 128
+                return jnp.zeros((n, 4), jnp.float32)
+
+            def dynamic_start_is_legal(work, start):
+                import jax
+                return jax.lax.dynamic_slice_in_dim(work, start, 128)
+        """})
+    assert "recompile-hazard" not in rules_hit(res)
+
+
+def test_recompile_hazard_suppression(tmp_path):
+    res = make_project(tmp_path, {
+        "lightgbm_tpu/ops/dyn.py": """\
+            import jax.numpy as jnp
+
+            def once(counts):
+                n = int(jnp.sum(counts))
+                return jnp.zeros((n, 4), jnp.float32)  # graftlint: disable=recompile-hazard -- one-time setup
+        """})
+    assert "recompile-hazard" not in rules_hit(res)
+    assert any(f.rule == "recompile-hazard" for f in res.suppressed)
+
+
+# --------------------------------------------------------- knob-contract
+
+def _knob_fixture(**overrides):
+    files = {
+        "lightgbm_tpu/config.py": """\
+            class Log:
+                @staticmethod
+                def fatal(msg, *a):
+                    raise ValueError(msg % a)
+
+            class Config:
+                tpu_foo_kernel: str = "auto"
+                tpu_bar_rows: int = 4096
+                tpu_flag: bool = True
+
+                def _check(self):
+                    if self.tpu_foo_kernel not in ("auto", "pallas", "xla"):
+                        Log.fatal("bad %s", self.tpu_foo_kernel)
+                    if self.tpu_bar_rows < 1:
+                        Log.fatal("bad %d", self.tpu_bar_rows)
+        """,
+        "lightgbm_tpu/learner.py": """\
+            def resolve(config, telemetry):
+                def _rec(knob, value, reason):
+                    telemetry.record("auto_resolution", knob=knob,
+                                     value=value, reason=reason)
+                if config.tpu_foo_kernel == "auto":
+                    _rec("tpu_foo_kernel", "pallas", "mosaic present")
+        """,
+        "scripts/foo_bisect.py":
+            '"""Hardware harness for tpu_foo_kernel."""\n',
+        "README.md": "| `tpu_foo_kernel` | `tpu_bar_rows` | `tpu_flag` |\n",
+    }
+    files.update(overrides)
+    return {k: v for k, v in files.items() if v is not None}
+
+
+def _knob_msgs(res):
+    return [f.message for f in res.findings if f.rule == "knob-contract"]
+
+
+def test_knob_contract_clean(tmp_path):
+    res = make_project(tmp_path, _knob_fixture())
+    assert "knob-contract" not in rules_hit(res)
+
+
+def test_knob_contract_missing_bisect(tmp_path):
+    """Deleting an auto knob's bisect harness trips the rule — and only
+    for the auto knob (fixed and bool knobs need no harness)."""
+    res = make_project(tmp_path, _knob_fixture(**{
+        "scripts/foo_bisect.py": None}))
+    msgs = _knob_msgs(res)
+    assert len(msgs) == 1 and "tpu_foo_kernel" in msgs[0] \
+        and "_bisect.py" in msgs[0]
+
+
+def test_knob_contract_missing_validation(tmp_path):
+    res = make_project(tmp_path, _knob_fixture(**{
+        "lightgbm_tpu/config.py": """\
+            class Config:
+                tpu_foo_kernel: str = "auto"
+                tpu_bar_rows: int = 4096
+                tpu_flag: bool = True
+
+                def _check(self):
+                    if self.tpu_foo_kernel not in ("auto", "pallas", "xla"):
+                        raise ValueError(self.tpu_foo_kernel)
+        """}))
+    msgs = _knob_msgs(res)
+    # tpu_bar_rows lost its clause; tpu_flag is bool and stays exempt
+    assert len(msgs) == 1 and "tpu_bar_rows" in msgs[0] \
+        and "validation" in msgs[0]
+
+
+def test_knob_contract_missing_readme_row(tmp_path):
+    res = make_project(tmp_path, _knob_fixture(**{
+        "README.md": "| `tpu_foo_kernel` | `tpu_flag` |\n"}))
+    msgs = _knob_msgs(res)
+    assert len(msgs) == 1 and "tpu_bar_rows" in msgs[0] \
+        and "README" in msgs[0]
+
+
+def test_knob_contract_unreasoned_resolution(tmp_path):
+    res = make_project(tmp_path, _knob_fixture(**{
+        "lightgbm_tpu/learner.py": """\
+            def resolve(config, telemetry):
+                def _rec(knob, value, reason):
+                    telemetry.record("auto_resolution", knob=knob,
+                                     value=value, reason=reason)
+                if config.tpu_foo_kernel == "auto":
+                    _rec("tpu_foo_kernel", "pallas", "")
+        """}))
+    msgs = _knob_msgs(res)
+    assert len(msgs) == 1 and "tpu_foo_kernel" in msgs[0] \
+        and "reason" in msgs[0]
+
+
+def test_knob_contract_missing_resolution(tmp_path):
+    res = make_project(tmp_path, _knob_fixture(**{
+        "lightgbm_tpu/learner.py": "def resolve(config):\n    pass\n"}))
+    msgs = _knob_msgs(res)
+    assert len(msgs) == 1 and "tpu_foo_kernel" in msgs[0] \
+        and "auto-resolution" in msgs[0]
+
+
+def test_knob_contract_suppression(tmp_path):
+    base = _knob_fixture(**{"scripts/foo_bisect.py": None})
+    base["lightgbm_tpu/config.py"] = base["lightgbm_tpu/config.py"].replace(
+        'tpu_foo_kernel: str = "auto"',
+        'tpu_foo_kernel: str = "auto"  '
+        '# graftlint: disable=knob-contract -- harness lands next PR')
+    res = make_project(tmp_path, base)
+    assert "knob-contract" not in rules_hit(res)
+    assert any(f.rule == "knob-contract" for f in res.suppressed)
+
+
+# --------------------------------------------------- baseline drift gate
+
+def test_stale_baseline_entries(tmp_path):
+    p = tmp_path / "lightgbm_tpu" / "x.py"
+    p.parent.mkdir(parents=True)
+    p.write_text("import time\nt0 = time.time()\n")
+    res = lint.run(str(tmp_path))
+    baseline = lint.baseline_from_findings(res.findings)
+    assert lint.stale_baseline_entries(str(tmp_path), baseline) == []
+    p.write_text("def f():\n    return 0\n")
+    stale = lint.stale_baseline_entries(str(tmp_path), baseline)
+    assert [e["rule"] for e in stale] == ["naked-timer"]
+    # a deleted file goes stale too
+    p.unlink()
+    assert len(lint.stale_baseline_entries(str(tmp_path), baseline)) == 1
+
+
+def _cli(args, root, **kw):
+    env = dict(os.environ, LGBTPU_LINT_ROOT=str(root))
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "lint.py")] + args,
+        capture_output=True, text=True, cwd=REPO, env=env, **kw)
+
+
+def test_cli_baseline_drift_lifecycle(tmp_path):
+    """Freeze -> fix -> the stale entry fails the run (baseline drift) ->
+    --update-baseline prunes it and reports the pruned count."""
+    p = tmp_path / "lightgbm_tpu" / "x.py"
+    p.parent.mkdir(parents=True)
+    p.write_text("import time\nt0 = time.time()\n")
+    assert _cli([], tmp_path).returncode == 1       # unbaselined finding
+    out = _cli(["--update-baseline"], tmp_path)
+    assert out.returncode == 0 and "1 findings frozen" in out.stdout
+    assert _cli([], tmp_path).returncode == 0       # frozen
+    p.write_text("def f():\n    return 0\n")        # fixed upstream
+    out = _cli([], tmp_path)
+    assert out.returncode == 1
+    assert "stale baseline entry" in out.stdout
+    out = _cli(["--update-baseline"], tmp_path)
+    assert out.returncode == 0 and "1 stale entry pruned" in out.stdout
+    assert _cli([], tmp_path).returncode == 0
+
+
+def test_cli_update_baseline_rejects_changed():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "lint.py"),
+         "--update-baseline", "--changed"],
+        capture_output=True, text=True, cwd=REPO)
+    assert out.returncode == 2
+    assert "full run" in out.stderr
+
+
+def test_cli_list_rules_lists_every_rule():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "lint.py"),
+         "--list-rules"],
+        capture_output=True, text=True, cwd=REPO)
+    assert out.returncode == 0
+    listed = {}
+    for line in out.stdout.splitlines():
+        rid, _, desc = line.partition(" ")
+        listed[rid] = desc.strip()
+    assert set(listed) == set(lint.all_rules())
+    for rid, rule in lint.all_rules().items():
+        assert rule.description, rid
+        assert listed[rid], rid
