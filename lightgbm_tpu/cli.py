@@ -214,9 +214,11 @@ class Application:
         for spec in cfg.serve_models:
             mid, path = spec.split("=", 1)
             entries.append((mid.strip(), path.strip()))
-        if not entries and not cfg.fleet_dir and not cfg.fleet_url:
+        if not entries and not cfg.fleet_dir and not cfg.fleet_url \
+                and not cfg.fleet_urls:
             Log.fatal("task=serve requires input_model or serve_models")
-        fleet_on = bool(cfg.fleet_dir) or bool(cfg.fleet_url)
+        fleet_on = bool(cfg.fleet_dir) or bool(cfg.fleet_url) \
+            or bool(cfg.fleet_urls)
         fleet_trainer = fleet_on and cfg.fleet_role == "trainer"
         fleet_replica = fleet_on and cfg.fleet_role == "replica"
         import socket
@@ -273,11 +275,30 @@ class Application:
                 store = FleetStore(cfg.fleet_dir, mid,
                                    read_only=fleet_replica)
                 booster, applied = bootstrap_model(store)
-            elif cfg.fleet_url:
-                from .fleet import RemoteStore, bootstrap_model
-                store = RemoteStore(cfg.fleet_url,
-                                    timeout_s=cfg.fleet_timeout_s,
-                                    backoff_max_s=cfg.fleet_backoff_max_s)
+            elif cfg.fleet_url or cfg.fleet_urls:
+                from .fleet import (MultiEndpointStore, RemoteStore,
+                                    RemoteWriteStore, bootstrap_model)
+                if fleet_trainer:
+                    # remote trainer: the full write surface (lease,
+                    # fenced publish, ingest/gate appends, compaction)
+                    # over HTTP against the store host — no shared
+                    # filesystem anywhere in the path
+                    store = RemoteWriteStore(
+                        cfg.fleet_urls[0],
+                        timeout_s=cfg.fleet_timeout_s,
+                        backoff_max_s=cfg.fleet_backoff_max_s)
+                elif len(cfg.fleet_urls) > 1:
+                    # multi-endpoint replica: liveness-ranked failover
+                    store = MultiEndpointStore(
+                        cfg.fleet_urls,
+                        timeout_s=cfg.fleet_timeout_s,
+                        backoff_max_s=cfg.fleet_backoff_max_s)
+                    store.probe()
+                else:
+                    store = RemoteStore(
+                        cfg.fleet_url or cfg.fleet_urls[0],
+                        timeout_s=cfg.fleet_timeout_s,
+                        backoff_max_s=cfg.fleet_backoff_max_s)
                 try:
                     booster, applied = bootstrap_model(store)
                 except Exception as exc:
@@ -285,7 +306,9 @@ class Application:
                     # watcher keeps retrying with backoff
                     Log.warning("fleet: remote bootstrap failed (%s: "
                                 "%s); watching %s for the first publish",
-                                type(exc).__name__, exc, cfg.fleet_url)
+                                type(exc).__name__, exc,
+                                cfg.fleet_url
+                                or ",".join(cfg.fleet_urls))
             if booster is not None:
                 Log.info("fleet: %s booted from published v%d",
                          mid, applied)
@@ -309,6 +332,7 @@ class Application:
                         holder_id=holder,
                         compact_bytes=cfg.fleet_compact_bytes,
                         keep_artifacts=cfg.fleet_keep_artifacts,
+                        snapshot_rows=cfg.fleet_snapshot_rows,
                         heartbeat_interval_s=cfg.fleet_heartbeat_interval_s)
             entry = registry.register(
                 mid, booster,
@@ -341,16 +365,43 @@ class Application:
             # local store: serve the /fleet transport routes (remote
             # replicas converge through them) + /healthz lease/log state
             server.fleet_store = store
-        elif cfg.fleet_url and store is not None:
+        elif (cfg.fleet_url or cfg.fleet_urls) and store is not None:
             # remote store: surface transport retry/backoff on /healthz
             server.fleet_transport = store
         host, port = server.address
+        if fleet_trainer:
+            # advertise this trainer's serving endpoint in the lease
+            # record (acquire/renew both write it): the leader_hint
+            # ingest forwarding resolves. The bound port is only known
+            # HERE, after the trainer exists — the next lease touch
+            # carries it (set mutable advertise_url, per-call url= for
+            # stores created before the bind)
+            adv_host = host if host not in ("0.0.0.0", "::") \
+                else __import__("socket").gethostname()
+            advertise = "http://%s:%d" % (adv_host, port)
+            try:
+                ent = registry.get()
+                if ent.online is not None:
+                    ent.online.advertise_url = advertise
+            except KeyError:
+                pass
+        if cfg.fleet_forward_ingest and store is not None:
+            # relay labeled traffic hitting this node to the lease
+            # holder instead of 409ing it (replicas and standbys have
+            # no online trainer to buffer it)
+            from .fleet import IngestForwarder
+            server.ingest_forwarder = IngestForwarder(
+                store=store if cfg.fleet_dir else None,
+                urls=(cfg.fleet_urls or
+                      ([cfg.fleet_url] if cfg.fleet_url else ())),
+                timeout_s=cfg.fleet_timeout_s)
         Log.info("Serving %s on http://%s:%d (POST /predict, /ingest; GET "
                  "/healthz, /models, /telemetry, /metrics)%s",
                  ", ".join("%s=%s" % e for e in entries), host, port,
                  " [fleet %s @ %s]" % (cfg.fleet_role,
-                                       cfg.fleet_dir or cfg.fleet_url)
-                 if (cfg.fleet_dir or cfg.fleet_url) else "")
+                                       cfg.fleet_dir or cfg.fleet_url
+                                       or ",".join(cfg.fleet_urls))
+                 if fleet_on else "")
         stop_dump = None
         if cfg.dump_telemetry and cfg.telemetry_dump_interval_s > 0:
             # a wedged server still leaves fresh counters on disk

@@ -287,6 +287,20 @@ class Config:
     #   node (trainer/standby/replica) records a compact heartbeat to the
     #   store (remote replicas POST /fleet/heartbeat) for the
     #   /fleet/status + fleetctl rollup. 0 = heartbeats off
+    fleet_urls: List[str] = field(default_factory=list)  # control plane:
+    #   MULTIPLE fleet endpoints. replica: liveness-ranked failover
+    #   (capped cooldown, switch on failure, exactly one version bump
+    #   per publish regardless of endpoint); trainer: the first url is
+    #   the store host the remote write surface (lease/publish/ingest/
+    #   compact over HTTP) talks to — no shared filesystem needed
+    fleet_forward_ingest: bool = False  # relay labeled traffic hitting
+    #   this node (no online trainer here) to the current lease
+    #   holder's advertised endpoint: leader_hint redirects, bounded
+    #   X-Fleet-Hops chain, 503 when no leader is known
+    fleet_snapshot_rows: int = 0      # compaction snapshot mode (0 = off):
+    #   write at least this many retained ingest rows into one versioned
+    #   snapshot blob instead of log lines, so a cold standby bootstraps
+    #   from snapshot + tail instead of a full replay
 
     # ---- objective (reference: config.h "Objective Parameters") ----
     num_class: int = 1
@@ -571,15 +585,40 @@ class Config:
             Log.fatal("fleet_poll_interval_s must be > 0, got %g",
                       self.fleet_poll_interval_s)
         if self.fleet_dir == "" and self.fleet_url == "" \
-                and self.fleet_role == "replica":
+                and not self.fleet_urls and self.fleet_role == "replica":
             Log.fatal("fleet_role=replica requires a fleet_dir (shared "
-                      "filesystem) or fleet_url (remote trainer) to watch")
-        if self.fleet_dir != "" and self.fleet_url != "":
-            Log.fatal("fleet_dir and fleet_url are mutually exclusive "
-                      "(one store per replica)")
+                      "filesystem), fleet_url or fleet_urls (remote "
+                      "endpoints) to watch")
+        if self.fleet_dir != "" and (self.fleet_url != ""
+                                     or self.fleet_urls):
+            Log.fatal("fleet_dir and fleet_url(s) are mutually exclusive "
+                      "(one store per node)")
+        if self.fleet_url != "" and self.fleet_urls:
+            Log.fatal("pass fleet_url or fleet_urls, not both")
         if self.fleet_url != "" and self.fleet_role != "replica":
-            Log.fatal("fleet_url is replica-only (the trainer owns the "
-                      "local store it serves)")
+            Log.fatal("fleet_url is replica-only; a remote TRAINER "
+                      "needs fleet_urls (the control-plane write "
+                      "surface)")
+        if self.fleet_urls and self.fleet_role == "trainer" \
+                and len(self.fleet_urls) != 1:
+            Log.fatal("fleet_role=trainer takes exactly one fleet url "
+                      "(the store host), got %d", len(self.fleet_urls))
+        if len(set(u.rstrip("/") for u in self.fleet_urls)) \
+                != len(self.fleet_urls):
+            Log.fatal("fleet_urls contains duplicates: %s",
+                      ",".join(self.fleet_urls))
+        if self.fleet_forward_ingest and self.fleet_dir == "" \
+                and not self.fleet_urls and self.fleet_url == "":
+            Log.fatal("fleet_forward_ingest needs a fleet store "
+                      "(fleet_dir) or fleet url(s) to resolve the "
+                      "lease holder from")
+        if self.fleet_snapshot_rows < 0:
+            Log.fatal("fleet_snapshot_rows must be >= 0 (0 disables "
+                      "snapshot compaction), got %d",
+                      self.fleet_snapshot_rows)
+        if self.fleet_snapshot_rows > 0 and self.fleet_compact_bytes == 0:
+            Log.fatal("fleet_snapshot_rows needs fleet_compact_bytes > 0 "
+                      "(snapshots are written at compaction time)")
         if self.fleet_lease_ttl_s < 0:
             Log.fatal("fleet_lease_ttl_s must be >= 0, got %g",
                       self.fleet_lease_ttl_s)

@@ -28,16 +28,31 @@ checksum verification); and :mod:`lightgbm_tpu.fleet.chaos` is the
 seeded fault-injection switchboard the failover tests drive all of it
 with.
 
+The region-scale control plane (PR 20) removes the last shared-disk
+assumption: :class:`~lightgbm_tpu.fleet.control.RemoteWriteStore` is
+the WRITE surface over HTTP (remote lease ops, server-side fenced
+publish with sha256-verified upload, ingest/gate appends, compaction),
+:class:`~lightgbm_tpu.fleet.control.MultiEndpointStore` gives replicas
+liveness-ranked multi-endpoint failover with capped cooldowns,
+:class:`~lightgbm_tpu.fleet.control.IngestForwarder` relays labeled
+traffic from any node to the lease holder (bounded leader-hint chain),
+and snapshot compaction (``FleetStore.compact(snapshot_rows=...)``)
+lets a cold standby bootstrap from one snapshot blob + log tail
+instead of a full replay.
+
 Per-tenant fairness (admission quotas + weighted-fair dequeue) lives in
 :mod:`lightgbm_tpu.serve.batcher`; promotion hysteresis and the
 auto-rollback live-metric watch live in
 :mod:`lightgbm_tpu.online.trainer` — this package provides the
 durability and distribution substrate they plug into.
 """
+from .control import (EndpointSelector, IngestForwarder,
+                      MultiEndpointStore, RemoteWriteStore)
 from .replica import ReplicaWatcher, bootstrap_model
 from .store import (CorruptArtifactError, FleetStore, StaleLeaseError)
 from .transport import RemoteStore, TransportError
 
 __all__ = ["FleetStore", "ReplicaWatcher", "RemoteStore",
-           "bootstrap_model", "StaleLeaseError", "CorruptArtifactError",
-           "TransportError"]
+           "RemoteWriteStore", "MultiEndpointStore", "EndpointSelector",
+           "IngestForwarder", "bootstrap_model", "StaleLeaseError",
+           "CorruptArtifactError", "TransportError"]

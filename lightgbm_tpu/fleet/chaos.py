@@ -22,9 +22,17 @@ Actions are tuples: ``("raise", exc)`` raises inside :func:`hit`;
 ``("sleep", seconds)`` stalls inside :func:`hit` (slow store / slow
 response); ``("torn", fraction)`` is RETURNED to the caller, which is
 responsible for truncating its write/read/response body to that
-fraction — tearing is inherently caller-specific. With no plan
-installed ``hit`` is one global load and a None check, so the hooks
-cost nothing in production.
+fraction — tearing is inherently caller-specific. Two fleet-control
+kinds ride the same queues: ``("partition", n)`` makes the point fail
+``n`` CONSECUTIVE times (it raises and re-queues itself at the front
+with ``n-1``, so one action simulates an endpoint dark for a whole
+window of requests, not one random drop); ``("reorder",)`` is returned
+to the caller like torn — the append path parks the entry it was about
+to write (:meth:`FaultPlan.park`) and lands it right AFTER its
+successor (:meth:`FaultPlan.take_parked`), the delayed-write-past-its-
+successor race a replicated log must tolerate. With no plan installed
+``hit`` is one global load and a None check, so the hooks cost nothing
+in production.
 """
 from __future__ import annotations
 
@@ -70,25 +78,52 @@ class FaultPlan:
         self._lock = threading.Lock()
         self._queues: Dict[str, List[Action]] = {}
         self._injected: Dict[str, int] = {}
+        self._parked: Dict[str, List[Any]] = {}
         for point, acts in (actions or {}).items():
             self.add(point, *acts)
 
+    #: seeded() default mix — frozen so pre-existing seeds keep their
+    #: byte-identical schedules; scenarios opt into the control-plane
+    #: kinds with kinds=KINDS_ALL
+    KINDS_DEFAULT = ("raise", "torn", "sleep")
+    KINDS_ALL = ("raise", "torn", "sleep", "partition", "reorder")
+
     @classmethod
     def seeded(cls, seed: int, counts: Dict[str, int], *,
-               sleep_s: float = 0.05) -> "FaultPlan":
+               sleep_s: float = 0.05,
+               kinds: Sequence[str] = KINDS_DEFAULT) -> "FaultPlan":
         """A plan with ``counts[point]`` faults per point, the action mix
         drawn deterministically from ``random.Random(seed)``. Same seed +
-        counts → byte-identical schedule, independent of wall clock."""
+        counts → byte-identical schedule, independent of wall clock.
+        ``kinds`` selects the mix (uniform over the tuple): the default
+        keeps the original raise/torn/sleep stream so existing seeds
+        reproduce; :data:`KINDS_ALL` adds partition/reorder for the
+        write-surface drills."""
         rng = random.Random(int(seed))
         plan = cls()
+        kinds = tuple(kinds)
+        legacy = kinds == cls.KINDS_DEFAULT
         for point in sorted(counts):
             for _ in range(int(counts[point])):
                 roll = rng.random()
-                if roll < 0.4:
+                if legacy:
+                    # the frozen original thresholds + draw order: same
+                    # seed → the exact schedule every pre-existing
+                    # chaos scenario was tuned against
+                    kind = ("raise" if roll < 0.4
+                            else "torn" if roll < 0.7 else "sleep")
+                else:
+                    kind = kinds[min(int(roll * len(kinds)),
+                                     len(kinds) - 1)]
+                if kind == "raise":
                     act: Action = ("raise",
                                    InjectedFault("chaos@%s" % point))
-                elif roll < 0.7:
+                elif kind == "torn":
                     act = ("torn", 0.1 + 0.8 * rng.random())
+                elif kind == "partition":
+                    act = ("partition", 1 + int(rng.random() * 3))
+                elif kind == "reorder":
+                    act = ("reorder",)
                 else:
                     act = ("sleep", sleep_s * rng.random())
                 plan.add(point, act)
@@ -102,6 +137,17 @@ class FaultPlan:
             self._queues.setdefault(point, []).extend(actions)
         return self
 
+    def push_front(self, point: str, *actions: Action) -> "FaultPlan":
+        """Queue ``actions`` ahead of everything pending at ``point`` —
+        how a ("partition", n) action re-queues its remaining n-1
+        failures so they hit the very next requests."""
+        if point not in FAILURE_POINTS:
+            raise ValueError("unknown chaos point %r (known: %s)"
+                             % (point, ", ".join(FAILURE_POINTS)))
+        with self._lock:
+            self._queues.setdefault(point, [])[:0] = list(actions)
+        return self
+
     def next_action(self, point: str) -> Optional[Action]:
         with self._lock:
             queue = self._queues.get(point)
@@ -109,6 +155,18 @@ class FaultPlan:
                 return None
             self._injected[point] = self._injected.get(point, 0) + 1
             return queue.pop(0)
+
+    def park(self, point: str, obj: Any) -> None:
+        """Reorder support: hold ``obj`` (an event the caller was about
+        to write) until the next write at ``point`` lands, then the
+        caller drains it via :meth:`take_parked` — the parked entry hits
+        the log AFTER its successor."""
+        with self._lock:
+            self._parked.setdefault(point, []).append(obj)
+
+    def take_parked(self, point: str) -> List[Any]:
+        with self._lock:
+            return self._parked.pop(point, [])
 
     def pending(self) -> Dict[str, int]:
         with self._lock:
@@ -158,10 +216,12 @@ def active() -> Optional[FaultPlan]:
 def hit(point: str) -> Optional[Action]:
     """Consume one fault at ``point`` if a plan is installed.
 
-    Raises for ("raise", exc) actions, stalls for ("sleep", s) actions,
-    and returns ("torn", fraction) for the caller to apply. Returns None
-    (and does nothing) when no plan is installed or the point's queue is
-    empty."""
+    Raises for ("raise", exc) and ("partition", n) actions (a partition
+    additionally re-queues itself at the front with n-1, so the point
+    stays dark for n consecutive calls), stalls for ("sleep", s)
+    actions, and returns ("torn", fraction) / ("reorder",) for the
+    caller to apply. Returns None (and does nothing) when no plan is
+    installed or the point's queue is empty."""
     plan = _active
     if plan is None:
         return None
@@ -175,6 +235,12 @@ def hit(point: str) -> Optional[Action]:
         if isinstance(exc, BaseException):
             raise exc
         raise exc("chaos@%s" % point)
+    if kind == "partition":
+        remaining = int(act[1])
+        if remaining > 1:
+            plan.push_front(point, ("partition", remaining - 1))
+        raise InjectedFault("partition@%s (%d request(s) left dark)"
+                            % (point, remaining))
     if kind == "sleep":
         time.sleep(float(act[1]))
         return None

@@ -11,9 +11,14 @@ many-workers decomposition of arXiv:1611.01276 applied to serving:
 replicas never train, they only apply whole historical models.
 
 The store is duck-typed: a filesystem
-:class:`~lightgbm_tpu.fleet.store.FleetStore` or a
-:class:`~lightgbm_tpu.fleet.transport.RemoteStore` polling a trainer's
-``/fleet`` endpoints over HTTP — the watcher code is identical. Loads
+:class:`~lightgbm_tpu.fleet.store.FleetStore`, a
+:class:`~lightgbm_tpu.fleet.transport.RemoteStore` polling one
+trainer's ``/fleet`` endpoints over HTTP, or a
+:class:`~lightgbm_tpu.fleet.control.MultiEndpointStore` failing over
+across a LIST of fleet endpoints (liveness-ranked, capped cooldowns) —
+the watcher code is identical in all three: version tokens are global,
+so exactly one version bump per applied publish holds no matter which
+endpoint served which poll. Loads
 go through ``latest_valid_publish``, which verifies each artifact
 against the sha256 + length in its publish event and walks back to the
 previous good publish past corruption; stale-epoch publishes from a

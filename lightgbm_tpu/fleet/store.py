@@ -88,30 +88,44 @@ STORE_VERSION = 1
 PUBLISH_EVENTS = ("boot", "promotion", "rollback")
 
 _ARTIFACT_FMT = "v%06d.txt"
+_SNAPSHOT_FMT = "s%06d.json"
 
 #: a lease-acquisition guard file older than this is a crashed acquirer
 _GUARD_STALE_S = 5.0
 
 
-def _verify_artifact(event: Dict[str, Any], data: bytes) -> None:
-    """Check artifact ``data`` against its publish event's sha256 + byte
-    length (shared with the HTTP transport's downloaded copies). Events
-    from before checksums carry no ``sha256`` and pass. Raises
+def _verify_blob(what: str, want_sha: Optional[str], want_bytes: int,
+                 data: bytes) -> None:
+    """sha256 + byte-length check shared by model artifacts and buffer
+    snapshots (and the HTTP transport's downloaded copies of both).
+    ``want_sha`` None passes (records from before checksums). Raises
     :class:`CorruptArtifactError` on mismatch."""
-    want_sha = event.get("sha256")
     if want_sha is None:
         return
-    version = int(event.get("version", 0))
-    want_bytes = int(event.get("bytes", -1))
     if want_bytes >= 0 and len(data) != want_bytes:
         raise CorruptArtifactError(
-            "artifact v%d truncated: %d bytes, event says %d"
-            % (version, len(data), want_bytes))
+            "%s truncated: %d bytes, event says %d"
+            % (what, len(data), want_bytes))
     got = hashlib.sha256(data).hexdigest()
     if got != want_sha:
         raise CorruptArtifactError(
-            "artifact v%d sha256 mismatch: %s != %s"
-            % (version, got, want_sha))
+            "%s sha256 mismatch: %s != %s" % (what, got, want_sha))
+
+
+def _verify_artifact(event: Dict[str, Any], data: bytes) -> None:
+    """Check artifact ``data`` against its publish event's sha256 + byte
+    length. Events from before checksums carry no ``sha256`` and pass.
+    Raises :class:`CorruptArtifactError` on mismatch."""
+    _verify_blob("artifact v%d" % int(event.get("version", 0)),
+                 event.get("sha256"), int(event.get("bytes", -1)), data)
+
+
+def _verify_snapshot(record: Dict[str, Any], data: bytes) -> None:
+    """Check snapshot ``data`` against its compact record's ``snapshot``
+    section (shared with the HTTP transport's downloaded copies)."""
+    snap = record.get("snapshot") or {}
+    _verify_blob("snapshot s%06d" % int(snap.get("id", 0)),
+                 snap.get("sha256"), int(snap.get("bytes", -1)), data)
 
 
 class StaleLeaseError(LightGBMError):
@@ -155,6 +169,7 @@ class FleetStore:
         self._models_dir = os.path.join(self._dir, "models")
         self._lease_path = os.path.join(self._dir, "lease.json")
         self._heartbeats_dir = os.path.join(self._dir, "heartbeats")
+        self._snapshots_dir = os.path.join(self._dir, "snapshots")
         os.makedirs(self._models_dir, exist_ok=True)
         # guards version allocation, the fence, compaction's rewrite and
         # the state counters; re-entrant because publish/compact append
@@ -285,7 +300,10 @@ class FleetStore:
         atomic rewrite (in-process by the store lock, cross-process by
         the events writer mutex), and carrying the ``store/append`` chaos
         point (a torn action writes a prefix of the line and raises — the
-        simulated crash the corrupt-line skip on replay recovers from)."""
+        simulated crash the corrupt-line skip on replay recovers from; a
+        reorder action parks the line so it lands right AFTER the next
+        append — the delayed-write-past-its-successor race replay's
+        log-order row offsets must stay consistent under)."""
         self._assert_writable()
         with self._lock, self._writer_mutex():
             act = chaos.hit("store/append")
@@ -298,7 +316,14 @@ class FleetStore:
                 raise chaos.InjectedFault(
                     "torn append (%d/%d bytes) at %s"
                     % (cut, len(line), entry.get("kind")))
+            plan = chaos.active()
+            if act is not None and act[0] == "reorder" and plan is not None:
+                plan.park("store/append", entry)
+                return
             append_jsonl(self._events_path, entry)
+            if plan is not None:
+                for parked in plan.take_parked("store/append"):
+                    append_jsonl(self._events_path, parked)
 
     def append_ingest(self, X, y) -> None:
         """Persist one labeled traffic chunk (one JSONL line). Called on
@@ -394,12 +419,18 @@ class FleetStore:
                 return False
             time.sleep(0.01)
 
-    def acquire_lease(self, holder: str, ttl_s: float) -> Optional[int]:
+    def acquire_lease(self, holder: str, ttl_s: float,
+                      url: Optional[str] = None) -> Optional[int]:
         """Try to take the trainer lease. Returns the new fencing epoch,
         or None while another live holder has it. EVERY successful
         acquisition — takeover of an expired lease, or re-acquisition by
         the same holder — bumps the epoch, so an epoch uniquely names
-        one continuous tenure."""
+        one continuous tenure.
+
+        ``url`` advertises the holder's serving endpoint in the lease
+        record: it is the ``leader_hint`` the control plane hands to
+        nodes whose labeled traffic must be forwarded to whoever can
+        actually train on it."""
         holder = str(holder)
         if ttl_s <= 0:
             raise LightGBMError("lease ttl_s must be > 0, got %g" % ttl_s)
@@ -413,10 +444,13 @@ class FleetStore:
                         and float(cur.get("expires_ts", 0.0)) > now):
                     return None
                 epoch = int(cur.get("epoch", 0)) + 1 if cur else 1
-                self._write_lease({
+                doc = {
                     "v": STORE_VERSION, "holder": holder, "epoch": epoch,
                     "expires_ts": now + float(ttl_s), "acquired_ts": now,
-                    "pid": os.getpid()})
+                    "pid": os.getpid()}
+                if url:
+                    doc["url"] = str(url)
+                self._write_lease(doc)
             finally:
                 self._guard_release(self._lease_path + ".lock")
         telemetry.count("fleet/lease_acquired")
@@ -425,7 +459,8 @@ class FleetStore:
                  holder, epoch, ttl_s)
         return epoch
 
-    def renew_lease(self, holder: str, epoch: int, ttl_s: float) -> bool:
+    def renew_lease(self, holder: str, epoch: int, ttl_s: float,
+                    url: Optional[str] = None) -> bool:
         """Heartbeat: extend the lease iff still held by ``holder`` at
         ``epoch``. An expired-but-untaken lease renews fine (the holder
         merely heartbeat late); a lease re-acquired by anyone (epoch
@@ -449,6 +484,10 @@ class FleetStore:
                     return False
                 now = time.time()  # graftlint: disable=naked-timer -- epoch timestamp, not a duration
                 cur["expires_ts"] = now + float(ttl_s)
+                if url:
+                    # a holder that learned its bound address after the
+                    # acquisition (ephemeral port) advertises it here
+                    cur["url"] = str(url)
                 self._write_lease(cur)
             finally:
                 self._guard_release(lock)
@@ -484,13 +523,14 @@ class FleetStore:
         cur = self._read_lease()
         if cur is None:
             return {"held": False, "holder": None, "epoch": 0,
-                    "expires_ts": 0.0}
+                    "expires_ts": 0.0, "url": None}
         expires = float(cur.get("expires_ts", 0.0))
         return {
             "held": expires > time.time(),  # graftlint: disable=naked-timer -- epoch timestamp, not a duration
             "holder": cur.get("holder"),
             "epoch": int(cur.get("epoch", 0)),
             "expires_ts": expires,
+            "url": cur.get("url"),
         }
 
     def set_fence(self, holder: str, epoch: int) -> None:
@@ -506,7 +546,8 @@ class FleetStore:
 
     # ---------------------------------------------------------------- publish
     def publish(self, model_str: str, event: str = "promotion",
-                meta: Optional[Dict[str, Any]] = None) -> int:
+                meta: Optional[Dict[str, Any]] = None, *,
+                fence: Optional[Tuple[str, int]] = None) -> int:
         """Publish one whole model under the next version token.
 
         The artifact is written to a temp path and ``os.replace``d (atomic
@@ -516,23 +557,35 @@ class FleetStore:
         :meth:`load_publish`) and the publisher's fencing epoch. When a
         fence is armed and the lease moved on, raises
         :class:`StaleLeaseError` BEFORE anything is written. Returns the
-        allocated version token."""
+        allocated version token.
+
+        ``fence`` is a per-call (holder, epoch) override for publishes
+        relayed on behalf of a REMOTE trainer (``POST /fleet/publish``):
+        the remote writer's claimed identity is checked against the
+        lease exactly like the local fence, without touching whatever
+        fence this process's own trainer armed via :meth:`set_fence`.
+        Epoch <= 0 in the override means an unfenced remote publisher
+        (same contract as local epoch-0 publishes)."""
         if event not in PUBLISH_EVENTS:
             raise LightGBMError("publish event must be one of %s, got %r"
                                 % ("|".join(PUBLISH_EVENTS), event))
         self._assert_writable()
         with self._lock:
+            eff_fence = self._fence
+            if fence is not None:
+                eff_fence = ((str(fence[0]), int(fence[1]))
+                             if int(fence[1]) > 0 else None)
             epoch = 0
-            if self._fence is not None:
+            if eff_fence is not None:
                 lease = self._read_lease()
                 if (lease is None
-                        or lease.get("holder") != self._fence[0]
-                        or int(lease.get("epoch", -1)) != self._fence[1]):
+                        or lease.get("holder") != eff_fence[0]
+                        or int(lease.get("epoch", -1)) != eff_fence[1]):
                     telemetry.count("fleet/stale_publishes_blocked")
                     raise StaleLeaseError(
-                        "publish fenced off: lease now %r, this trainer "
-                        "held %r" % (lease, self._fence))
-                epoch = self._fence[1]
+                        "publish fenced off: lease now %r, this publisher "
+                        "held %r" % (lease, eff_fence))
+                epoch = eff_fence[1]
             # a previous active trainer (another process, another store
             # instance over the same dir) may have published since this
             # store was opened: re-read the allocation floor from the log
@@ -752,7 +805,8 @@ class FleetStore:
 
     # ------------------------------------------------------------- compaction
     def compact(self, *, watermark: int, wins: int, keep_rows: int,
-                keep_artifacts: int = 0) -> Dict[str, Any]:
+                keep_artifacts: int = 0,
+                snapshot_rows: int = 0) -> Dict[str, Any]:
         """Snapshot trainer state and truncate the replayed prefix.
 
         Writes one ``compact`` record carrying the gate snapshot
@@ -776,6 +830,21 @@ class FleetStore:
         retention window — they are dropped and their artifacts deleted;
         the compact record's version/epoch floors stand in for them) and
         deletes the unretained artifact files; 0 keeps all publishes.
+
+        ``snapshot_rows`` > 0 turns on **snapshot bootstrap** mode: the
+        retained ingest chunks (the retention rule above, with the keep
+        floor raised to ``max(keep_rows, snapshot_rows)``) are written
+        to ONE versioned snapshot artifact under ``snapshots/`` instead
+        of back into the log, and the compact record carries the
+        snapshot's id + sha256 + byte length. A cold standby then
+        bootstraps from snapshot + log tail — one sequential blob read
+        (or one HTTP GET) instead of replaying per-chunk JSONL — and a
+        later compaction splices the previous snapshot's chunks back
+        into its retention scan, so nothing covered by the shadow window
+        is ever silently dropped across snapshot generations. Replay of
+        snapshot + tail is bit-identical to full-log replay (pinned in
+        tests/test_control.py, including a mid-shadow-window cut).
+
         Returns a summary dict. The whole snapshot→rewrite section holds
         the cross-process events writer mutex: a standby trainer's
         ingest append from another process blocks until the ``os.replace``
@@ -787,6 +856,7 @@ class FleetStore:
             row_base = 0
             last_version = 0
             lease_epoch = 0
+            snap_floor = 0
             ingests: List[Tuple[int, int, Dict[str, Any]]] = []
             # (event, is_stale) — staleness mirrors _scan_publishes:
             # a non-zero epoch below the running max (which includes
@@ -796,6 +866,17 @@ class FleetStore:
             for e in events:
                 kind = e.get("kind")
                 if kind == "compact":
+                    snap = e.get("snapshot")
+                    if isinstance(snap, dict):
+                        snap_floor = max(snap_floor,
+                                         int(snap.get("id", 0)))
+                        # splice the previous snapshot's chunks back in
+                        # as virtual ingest events at their original
+                        # offsets: this compaction's retention (and its
+                        # own snapshot, if any) sees one uniform
+                        # contiguous chunk list
+                        for lo, hi, ev in self.snapshot_chunks(e):
+                            ingests.append((lo, hi, ev))
                     base = int(e.get("row_base", 0))
                     seen = base if seen is None else seen
                     row_base = base
@@ -817,13 +898,22 @@ class FleetStore:
                         lease_epoch = max(lease_epoch, epoch)
                     publishes.append((e, is_stale))
             total_rows = ingests[-1][1] if ingests else row_base
-            # retained = mandatory unconsumed suffix + shadow-cover suffix
+            # the earliest row any replay could still reconstruct before
+            # this compaction (spliced snapshot chunks included) — the
+            # baseline dropped_rows is measured against
+            old_floor = ingests[0][0] if ingests else row_base
+            # retained = mandatory unconsumed suffix + shadow-cover
+            # suffix; snapshot mode raises the keep floor so the
+            # snapshot warms at least snapshot_rows of recent traffic
+            eff_keep = int(keep_rows)
+            if int(snapshot_rows) > 0:
+                eff_keep = max(eff_keep, int(snapshot_rows))
             keep_from = len(ingests)
             acc = 0
             for i in range(len(ingests) - 1, -1, -1):
                 lo, hi, e = ingests[i]
                 n = int(e.get("n", 0))
-                if hi > int(watermark) or acc + n <= int(keep_rows):
+                if hi > int(watermark) or acc + n <= eff_keep:
                     acc += n
                     keep_from = i
                 else:
@@ -846,16 +936,30 @@ class FleetStore:
                             dropped_artifacts += 1
                         except OSError:
                             pass
+            snap_section = None
+            if int(snapshot_rows) > 0 and kept_ingests:
+                snap_section = self._write_snapshot(
+                    snap_floor + 1, new_row_base, int(total_rows),
+                    kept_ingests)
             record = self._stamp("compact", {
                 "watermark": int(watermark), "wins": int(wins),
-                "row_base": int(new_row_base),
+                # with a snapshot the log itself keeps NO ingest lines:
+                # its row offsets resume at total_rows and the snapshot
+                # section carries the preserved [row_base, top_row) span
+                "row_base": int(total_rows) if snap_section is not None
+                else int(new_row_base),
                 "last_version": int(last_version),
                 "lease_epoch": int(lease_epoch),
-                "dropped_events": len(events) - len(kept_ingests)
-                - len(kept_publishes),
-                "dropped_rows": int(new_row_base - row_base)})
-            lines = [record] + kept_publishes + [e for _, _, e in
-                                                kept_ingests]
+                # clamped: spliced snapshot chunks are not log lines, so
+                # they can outnumber the events they were folded from
+                "dropped_events": max(0, len(events) - len(kept_ingests)
+                                      - len(kept_publishes)),
+                "dropped_rows": int(new_row_base - old_floor)})
+            if snap_section is not None:
+                record["snapshot"] = snap_section
+            lines = [record] + kept_publishes
+            if snap_section is None:
+                lines += [e for _, _, e in kept_ingests]
             tmp = self._events_path + ".tmp.%d" % os.getpid()
             data = "".join(json.dumps(entry, sort_keys=True) + "\n"
                            for entry in lines).encode("utf-8")
@@ -889,7 +993,124 @@ class FleetStore:
                 "dropped_rows": record["dropped_rows"],
                 "dropped_artifacts": dropped_artifacts,
                 "row_base": int(new_row_base),
+                "snapshot": snap_section,
                 "log_bytes": self.log_bytes()}
+
+    # -------------------------------------------------------------- snapshots
+    def snapshot_path(self, sid: int) -> str:
+        return os.path.join(self._snapshots_dir, _SNAPSHOT_FMT % int(sid))
+
+    def _scan_snapshot_ids(self) -> List[int]:
+        try:
+            names = os.listdir(self._snapshots_dir)
+        except OSError:
+            return []
+        ids = []
+        for name in names:
+            if name.startswith("s") and name.endswith(".json"):
+                try:
+                    ids.append(int(name[1:-5]))
+                except ValueError:
+                    continue
+        return sorted(ids)
+
+    def _write_snapshot(self, sid_min: int, row_base: int, top_row: int,
+                        kept_ingests: List[Tuple[int, int, Dict[str, Any]]]
+                        ) -> Dict[str, Any]:
+        """Write the retained ingest chunks to one versioned snapshot
+        blob (``snapshots/s%06d.json``, tmp + fsync + ``os.replace``) and
+        return the ``snapshot`` section for the compact record. The
+        chunks carry their original ingest events verbatim plus their
+        global row offsets, so replaying snapshot + tail is bit-identical
+        to replaying the uncompacted log. Ids are monotonic across
+        generations (never below ``sid_min``, the prior snapshot's id +
+        1, even if its file was already pruned); older snapshot files are
+        pruned after the replace — the log's compact record is the only
+        pointer, and it always points at the newest."""
+        os.makedirs(self._snapshots_dir, exist_ok=True)
+        existing = self._scan_snapshot_ids()
+        sid = max(int(sid_min), (existing[-1] + 1) if existing else 1)
+        doc = {"v": STORE_VERSION, "kind": "snapshot", "id": sid,
+               "model_id": self._model_id,
+               "row_base": int(row_base), "top_row": int(top_row),
+               "chunks": [{"lo": int(lo), "event": e}
+                          for lo, _hi, e in kept_ingests]}
+        data = json.dumps(doc, sort_keys=True).encode("utf-8")
+        path = self.snapshot_path(sid)
+        tmp = path + ".tmp.%d" % os.getpid()
+        fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
+        try:
+            view = memoryview(data)
+            done = 0
+            while done < len(view):
+                done += os.write(fd, view[done:])
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+        os.replace(tmp, path)
+        for old in existing:
+            if old < sid:
+                try:
+                    os.unlink(self.snapshot_path(old))
+                except OSError:
+                    pass
+        rows = sum(int(e.get("n", 0)) for _lo, _hi, e in kept_ingests)
+        telemetry.count("fleet/snapshots_written")
+        telemetry.gauge("fleet/snapshot_bytes", len(data))
+        Log.info("fleet: wrote snapshot s%06d for %s: %d row(s) in "
+                 "[%d, %d), %d bytes", sid, self._model_id, rows,
+                 row_base, top_row, len(data))
+        return {"id": sid, "sha256": hashlib.sha256(data).hexdigest(),
+                "bytes": len(data), "rows": rows,
+                "row_base": int(row_base), "top_row": int(top_row)}
+
+    def snapshot_bytes(self, sid: int) -> bytes:
+        """Raw snapshot blob (chaos ``store/artifact_read`` torn actions
+        apply, mirroring model-artifact reads)."""
+        act = chaos.hit("store/artifact_read")
+        with open(self.snapshot_path(sid), "rb") as f:
+            data = f.read()
+        if act is not None and act[0] == "torn":
+            data = data[:int(len(data) * float(act[1]))]
+        return data
+
+    def load_snapshot(self, record: Dict[str, Any]) -> Dict[str, Any]:
+        """Read + verify the snapshot behind one compact record's
+        ``snapshot`` section. Raises :class:`CorruptArtifactError` on
+        sha256/length mismatch, ``OSError`` when the file is gone."""
+        snap = record.get("snapshot") or {}
+        data = self.snapshot_bytes(int(snap.get("id", 0)))
+        _verify_snapshot(record, data)
+        return json.loads(data.decode("utf-8"))
+
+    def snapshot_chunks(self, record: Dict[str, Any]
+                        ) -> List[Tuple[int, int, Dict[str, Any]]]:
+        """The ingest chunks preserved by ``record``'s snapshot, as
+        ``(lo, hi, event)`` at their original global row offsets — what
+        replay and the next compaction splice back in place of the log
+        lines the snapshot replaced. Degrades to ``[]`` (with a warning)
+        when the snapshot is missing or corrupt: because the compact
+        record's ``row_base`` already equals the snapshot's ``top_row``,
+        later offsets stay consistent — the failure costs buffered rows,
+        never misaligns the log."""
+        snap = record.get("snapshot")
+        if not isinstance(snap, dict):
+            return []
+        try:
+            doc = self.load_snapshot(record)
+        except (OSError, ValueError, CorruptArtifactError) as exc:
+            telemetry.count("fleet/snapshot_load_failures")
+            Log.warning("fleet: snapshot s%06d unreadable (%s); replay "
+                        "continues degraded without its %s buffered "
+                        "row(s)", int(snap.get("id", 0)), exc,
+                        snap.get("rows", "?"))
+            return []
+        out: List[Tuple[int, int, Dict[str, Any]]] = []
+        for c in doc.get("chunks", []):
+            ev = c.get("event") or {}
+            lo = int(c.get("lo", 0))
+            out.append((lo, lo + int(ev.get("n", 0)), ev))
+        return out
 
     # ------------------------------------------------------------- heartbeats
     def record_heartbeat(self, doc: Dict[str, Any]) -> bool:

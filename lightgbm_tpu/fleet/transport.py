@@ -53,6 +53,25 @@ class _NotFound(Exception):
     """Internal: the remote answered 404 (a meaning, not a failure)."""
 
 
+class _Rejected(Exception):
+    """Internal: the remote answered a status listed in ``no_retry`` —
+    a protocol verdict (fence 409, bad upload 400), not an outage.
+    Carries the code and decoded body so the caller can read the
+    verdict's payload (e.g. a ``leader_hint``)."""
+
+    def __init__(self, code: int, body: bytes) -> None:
+        super().__init__("HTTP %d" % code)
+        self.code = int(code)
+        self.body = body
+
+    def doc(self) -> Dict[str, Any]:
+        try:
+            doc = json.loads(self.body.decode("utf-8"))
+        except (ValueError, UnicodeDecodeError):
+            return {}
+        return doc if isinstance(doc, dict) else {}
+
+
 class RemoteStore:
     """Read-only fleet store over HTTP, duck-typing ``FleetStore``'s
     replica-facing surface.
@@ -110,10 +129,14 @@ class RemoteStore:
         return min(self._backoff_max,
                    self._backoff_base * (2.0 ** attempt)) * factor
 
-    def _request(self, path: str, data: Optional[bytes] = None) -> bytes:
+    def _request(self, path: str, data: Optional[bytes] = None,
+                 no_retry: Tuple[int, ...] = ()) -> bytes:
         """GET ``path`` (POST when ``data`` is given) with retries.
         Raises :class:`_NotFound` on 404 (no retry — absence is an
-        answer) and :class:`TransportError` once every attempt failed.
+        answer), :class:`_Rejected` for statuses in ``no_retry`` (a
+        protocol verdict — retrying a fence rejection would just hammer
+        the new leader's 409), and :class:`TransportError` once every
+        attempt failed.
 
         The active span's trace id (if any) rides along as
         ``X-Trace-Id`` so the trainer-side handler can join its serve
@@ -150,6 +173,8 @@ class RemoteStore:
             except urllib.error.HTTPError as exc:
                 if exc.code == 404:
                     raise _NotFound(path)
+                if exc.code in no_retry:
+                    raise _Rejected(exc.code, exc.read() or b"")
                 last = exc  # 5xx/4xx: retry — the server may be mid-restart
             except (OSError, http.client.HTTPException,
                     chaos.InjectedFault) as exc:

@@ -220,7 +220,9 @@ class OnlineTrainer:
                  holder_id: Optional[str] = None,
                  compact_bytes: int = 0,
                  keep_artifacts: int = 0,
+                 snapshot_rows: int = 0,
                  heartbeat_interval_s: float = 0.0,
+                 advertise_url: Optional[str] = None,
                  candidate_factory=None,
                  start: bool = True) -> None:
         if mode not in MODES:
@@ -253,6 +255,13 @@ class OnlineTrainer:
         if compact_bytes < 0 or keep_artifacts < 0:
             raise LightGBMError("online compact_bytes/keep_artifacts "
                                 "must be >= 0")
+        if snapshot_rows < 0:
+            raise LightGBMError("online snapshot_rows must be >= 0 "
+                                "(0 disables snapshot compaction), got %d"
+                                % snapshot_rows)
+        if snapshot_rows > 0 and store is None:
+            raise LightGBMError("online snapshot_rows needs a fleet "
+                                "store to snapshot into")
         if heartbeat_interval_s < 0:
             raise LightGBMError("online heartbeat_interval_s must be "
                                 ">= 0 (0 disables heartbeats), got %g"
@@ -285,6 +294,14 @@ class OnlineTrainer:
             else "pid-%d" % os.getpid()
         self._compact_bytes = int(compact_bytes)
         self._keep_artifacts = int(keep_artifacts)
+        self._snapshot_rows = int(snapshot_rows)
+        # control plane: the URL this trainer's serving endpoint is
+        # reachable at, advertised in the lease record at acquire/renew
+        # time — the leader_hint ingest forwarding follows. Public and
+        # mutable: a server bound to an ephemeral port learns its
+        # address after the trainer exists, and the next renew
+        # advertises it.
+        self.advertise_url = str(advertise_url) if advertise_url else None
         self._replay_on_acquire = bool(replay)
         # test/extension hook: a callable (X, y) -> Booster replaces the
         # default candidate build (degraded-candidate gate tests)
@@ -444,24 +461,18 @@ class OnlineTrainer:
             self._wins = wins
         seen = 0
         replayed = 0
-        for e in events:
-            kind = e.get("kind")
-            if kind == "compact":
-                seen = max(seen, int(e.get("row_base", 0)))
-                continue
-            if kind != "ingest":
-                continue
+
+        def push_chunk(lo: int, e: Dict[str, Any]) -> int:
             try:
                 X = np.asarray(e["rows"], np.float64)
                 y = np.asarray(e["labels"], np.float64).ravel()
             except (KeyError, TypeError, ValueError):
-                continue   # a malformed entry must not block the boot
+                return 0   # a malformed entry must not block the boot
             if X.ndim == 1:
                 X = X[None, :]
             if len(y) == 0 or X.shape[0] != len(y):
-                continue
-            lo, hi = seen, seen + len(y)
-            seen = hi
+                return 0
+            hi = lo + len(y)
             if hi <= watermark:
                 self.buffer.push(X, y, training=False)
             elif lo >= watermark:
@@ -472,7 +483,31 @@ class OnlineTrainer:
                 cut = watermark - lo
                 self.buffer.push(X[:cut], y[:cut], training=False)
                 self.buffer.push(X[cut:], y[cut:])
-            replayed += len(y)
+            return len(y)
+
+        for e in events:
+            kind = e.get("kind")
+            if kind == "compact":
+                if isinstance(e.get("snapshot"), dict):
+                    # snapshot bootstrap: the record's row_base already
+                    # sits PAST the snapshotted span, so its chunks are
+                    # pushed here at their recorded offsets (one blob
+                    # read instead of per-chunk log lines); a missing
+                    # or corrupt snapshot degrades to zero chunks with
+                    # offsets intact — lost buffer warmth, never a
+                    # misaligned replay
+                    loader = getattr(self._store, "snapshot_chunks",
+                                     None)
+                    if loader is not None:
+                        for lo, _hi, ev in loader(e):
+                            replayed += push_chunk(lo, ev)
+                seen = max(seen, int(e.get("row_base", 0)))
+                continue
+            if kind != "ingest":
+                continue
+            n = push_chunk(seen, e)
+            seen += n
+            replayed += n
         with self._lock:
             self._consumed_rows = min(watermark, seen)
             self._replayed_rows = replayed
@@ -556,8 +591,15 @@ class OnlineTrainer:
             if not self._standby:
                 return True
         try:
-            epoch = self._store.acquire_lease(self._holder,
-                                              self._lease_ttl)
+            # url= only when advertised: fake stores in tests (and real
+            # ones predating the control plane) take two positionals
+            if self.advertise_url:
+                epoch = self._store.acquire_lease(
+                    self._holder, self._lease_ttl,
+                    url=self.advertise_url)
+            else:
+                epoch = self._store.acquire_lease(self._holder,
+                                                  self._lease_ttl)
         except Exception as exc:
             Log.warning("fleet: lease acquisition failed: %s: %s",
                         type(exc).__name__, exc)
@@ -615,8 +657,13 @@ class OnlineTrainer:
             return True
         renewed = False
         try:
-            renewed = self._store.renew_lease(self._holder, epoch,
-                                              self._lease_ttl)
+            if self.advertise_url:
+                renewed = self._store.renew_lease(
+                    self._holder, epoch, self._lease_ttl,
+                    url=self.advertise_url)
+            else:
+                renewed = self._store.renew_lease(self._holder, epoch,
+                                                  self._lease_ttl)
         except Exception as exc:
             Log.warning("fleet: lease renewal errored: %s: %s",
                         type(exc).__name__, exc)
@@ -800,9 +847,14 @@ class OnlineTrainer:
         try:
             if self._store.log_bytes() <= self._compact_bytes:
                 return
+            kw = {}
+            if self._snapshot_rows > 0:
+                # snapshot-bootstrap mode (only passed when on, so fake
+                # stores with the narrow compact signature keep working)
+                kw["snapshot_rows"] = self._snapshot_rows
             self._store.compact(watermark=consumed, wins=wins,
                                 keep_rows=self.buffer.shadow_capacity,
-                                keep_artifacts=self._keep_artifacts)
+                                keep_artifacts=self._keep_artifacts, **kw)
         except Exception as exc:
             # retention is best-effort; an uncompacted log only costs
             # disk, never correctness

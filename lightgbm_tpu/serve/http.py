@@ -19,14 +19,27 @@
     GET  /fleet/artifact/<v>   raw whole-model artifact bytes
     GET  /fleet/status         federated rollup: head version, lease,
                                every node's latest heartbeat with skew
+    GET  /fleet/events         the whole event log (remote replay)
+    GET  /fleet/snapshot/<id>  raw snapshot blob (remote cold bootstrap)
     POST /fleet/heartbeat      remote nodes report their heartbeat docs
+    POST /fleet/lease          remote lease acquire/renew/release/state
+    POST /fleet/publish        sha256-verified model upload, fenced by
+                               (holder, lease_epoch); zombie epoch: 409
+    POST /fleet/ingest         append one labeled chunk to the store log
+    POST /fleet/gate           append one promotion-gate record
+    POST /fleet/compact        run log compaction (snapshot mode incl.)
 
-The three /fleet routes exist when the CLI attaches a local
-``FleetStore`` (``server.fleet_store``): they are the network transport
-remote replicas (:class:`~lightgbm_tpu.fleet.transport.RemoteStore`)
-converge through, so a replica no longer needs the trainer's
-filesystem. They carry the ``transport/serve`` chaos point (slow/torn/
-dropped responses for the failover tests).
+The /fleet routes exist when the CLI attaches a local ``FleetStore``
+(``server.fleet_store``). The GETs are the network transport remote
+replicas (:class:`~lightgbm_tpu.fleet.transport.RemoteStore`) converge
+through; the POSTs are the control plane's write surface
+(:class:`~lightgbm_tpu.fleet.control.RemoteWriteStore`) — fencing is
+enforced server-side under the store lock, so a remote zombie's stale
+epoch is rejected 409 (with a ``leader_hint``) exactly like a local
+one. Both carry the ``transport/serve`` chaos point (slow/torn/dropped
+responses for the failover tests). The write routes answer during a
+drain: a draining store host must keep serving lease renewals or a
+healthy remote trainer would demote for no reason.
 
 Multi-tenant: the server fronts a
 :class:`~lightgbm_tpu.online.registry.ModelRegistry`; the single-model
@@ -47,6 +60,7 @@ device dispatch. No dependencies beyond the standard library.
 """
 from __future__ import annotations
 
+import hashlib
 import json
 import threading
 import time
@@ -121,6 +135,10 @@ class PredictServer:
         self.fleet_store = None
         # remote-replica mode: the RemoteStore, for /healthz retry stats
         self.fleet_transport = None
+        # control plane: an IngestForwarder attached here relays labeled
+        # traffic hitting this node to the current lease holder instead
+        # of 409ing it on the floor
+        self.ingest_forwarder = None
         self._started_at = obs.monotonic()
         # guards the draining flag: flipped by begin_shutdown (signal
         # helper thread) and read on every handler thread
@@ -207,6 +225,27 @@ class PredictServer:
                 if seg == ["fleet", "status"]:
                     send(json.dumps(server.fleet_status())
                          .encode("utf-8"), "application/json")
+                elif seg == ["fleet", "events"]:
+                    # remote standby cold-boot replay: the whole event
+                    # log in one response (with snapshot compaction on,
+                    # this is a compact record + publishes + tail)
+                    send(json.dumps({"events": list(store.events())})
+                         .encode("utf-8"), "application/json")
+                elif seg[:2] == ["fleet", "snapshot"] and len(seg) == 3:
+                    try:
+                        sid = int(seg[2])
+                    except ValueError:
+                        self._json(404, {"error": "bad snapshot id %r"
+                                         % seg[2]})
+                        return
+                    try:
+                        with open(store.snapshot_path(sid), "rb") as f:
+                            data = f.read()
+                    except OSError:
+                        self._json(404, {"error": "no snapshot s%06d"
+                                         % sid})
+                        return
+                    send(data, "application/json")
                 elif seg == ["fleet", "latest"]:
                     latest = store.latest_publish()
                     if latest is None:
@@ -247,6 +286,15 @@ class PredictServer:
                     # serve plane drains, so this precedes the 503 gate
                     self._fleet_heartbeat(payload)
                     return
+                if self.path.startswith("/fleet/"):
+                    # the control plane's write surface (remote lease,
+                    # fenced publish, ingest/gate appends, compaction).
+                    # Like heartbeats it precedes the drain gate: a
+                    # draining store host must keep answering lease
+                    # renewals and fence checks or a healthy remote
+                    # trainer demotes for no reason
+                    self._fleet_post(payload)
+                    return
                 if server.draining():
                     telemetry.count("serve/drain_rejected")
                     self._json(503, {"error": "server is draining"})
@@ -267,6 +315,133 @@ class PredictServer:
                     self._predict(entry, payload)
                 else:
                     self._ingest(entry, payload)
+
+            def _fleet_post(self, payload) -> None:
+                """``POST /fleet/{lease,publish,ingest,gate,compact}`` —
+                the store host's half of the remote write surface.
+                Every route needs the attached local store; fencing is
+                enforced HERE, under the store's own lock, so a remote
+                zombie's stale epoch dies exactly like a local one
+                (409, with a ``leader_hint`` naming who holds the lease
+                now). Chaos ``transport/serve`` actions apply as on the
+                GET side: raise answers 500, torn truncates the body
+                under an intact Content-Length."""
+                store = server.fleet_store
+                if store is None:
+                    self._json(404, {"error": "no fleet store attached"})
+                    return
+                if not isinstance(payload, dict):
+                    self._json(400, {"error": "body must be a JSON "
+                                     "object"})
+                    return
+                from ..fleet import chaos
+                from ..fleet.store import StaleLeaseError
+                try:
+                    act = chaos.hit("transport/serve")
+                except Exception as exc:
+                    self._json(500, {"error": "%s: %s"
+                                     % (type(exc).__name__, exc)})
+                    return
+                torn = float(act[1]) if act is not None \
+                    and act[0] == "torn" else None
+
+                def send(code: int, obj) -> None:
+                    body = json.dumps(obj).encode("utf-8")
+                    if torn is not None:
+                        body = body[:int(len(body) * torn)]
+                        self._raw(code, body, "application/json")
+                        return
+                    self._json(code, obj)
+
+                seg = [s for s in self.path.split("/") if s]
+                route = seg[1] if len(seg) == 2 else ""
+                try:
+                    if route == "lease":
+                        self._fleet_lease(store, payload, send)
+                    elif route == "publish":
+                        self._fleet_publish(store, payload, send)
+                    elif route == "ingest":
+                        store.append_ingest(payload["rows"],
+                                            payload["labels"])
+                        rows = payload.get("labels") or []
+                        send(200, {"ok": True, "rows": len(rows)})
+                    elif route == "gate":
+                        store.append_gate(
+                            payload["result"], int(payload["wins"]),
+                            int(payload["consumed_rows"]),
+                            payload.get("losses"))
+                        send(200, {"ok": True})
+                    elif route == "compact":
+                        send(200, store.compact(
+                            watermark=int(payload["watermark"]),
+                            wins=int(payload["wins"]),
+                            keep_rows=int(payload["keep_rows"]),
+                            keep_artifacts=int(
+                                payload.get("keep_artifacts", 0)),
+                            snapshot_rows=int(
+                                payload.get("snapshot_rows", 0))))
+                    else:
+                        self._json(404, {"error": "unknown path %s"
+                                         % self.path})
+                except StaleLeaseError as exc:
+                    doc = {"error": str(exc)}
+                    hint = server._leader_hint()
+                    if hint:
+                        doc["leader_hint"] = hint
+                    send(409, doc)
+                except (KeyError, TypeError, ValueError,
+                        LightGBMError) as exc:
+                    send(400, {"error": "%s: %s"
+                               % (type(exc).__name__, exc)})
+
+            def _fleet_lease(self, store, payload, send) -> None:
+                op = payload.get("op")
+                holder = payload.get("holder")
+                url = payload.get("url") or None
+                if op == "acquire":
+                    epoch = store.acquire_lease(
+                        str(holder), float(payload["ttl_s"]), url=url)
+                    send(200, {"epoch": epoch,
+                               "lease": store.lease_state()})
+                elif op == "renew":
+                    ok = store.renew_lease(
+                        str(holder), int(payload["epoch"]),
+                        float(payload["ttl_s"]), url=url)
+                    send(200, {"ok": ok})
+                elif op == "release":
+                    ok = store.release_lease(str(holder),
+                                             int(payload["epoch"]))
+                    send(200, {"ok": ok})
+                elif op == "state":
+                    send(200, {"lease": store.lease_state()})
+                else:
+                    send(400, {"error": "unknown lease op %r" % op})
+
+            def _fleet_publish(self, store, payload, send) -> None:
+                model = payload.get("model")
+                if not isinstance(model, str) or not model:
+                    send(400, {"error": "publish needs a non-empty "
+                               "model string"})
+                    return
+                data = model.encode("utf-8")
+                want_sha = payload.get("sha256")
+                want_bytes = int(payload.get("bytes", -1))
+                got_sha = hashlib.sha256(data).hexdigest()
+                if (want_bytes >= 0 and want_bytes != len(data)) \
+                        or (want_sha and want_sha != got_sha):
+                    # verify the UPLOAD before the fence: a torn body
+                    # must never become an artifact, fenced or not
+                    telemetry.count("fleet/upload_checksum_failures")
+                    send(400, {"error": "model upload failed its "
+                               "checksum (%d bytes, sha %s...)"
+                               % (len(data), got_sha[:12])})
+                    return
+                fence = (str(payload.get("holder")),
+                         int(payload.get("lease_epoch", 0)))
+                version = store.publish(
+                    model, str(payload.get("event", "promotion")),
+                    payload.get("meta"), fence=fence)
+                send(200, {"version": version})
 
             def _fleet_heartbeat(self, payload) -> None:
                 store = server.fleet_store
@@ -325,9 +500,31 @@ class PredictServer:
 
             def _ingest(self, entry, payload) -> None:
                 if entry.online is None:
-                    self._json(409, {"error": "online training is not "
-                                     "enabled for model %r"
-                                     % entry.model_id})
+                    fwd = server.ingest_forwarder
+                    hops = int(self.headers.get("X-Fleet-Hops") or 0)
+                    if fwd is not None:
+                        # this node cannot train on the rows, but the
+                        # control plane knows who can: relay to the
+                        # lease holder instead of dropping the chunk
+                        try:
+                            doc = fwd.forward(entry.model_id,
+                                              payload.get("rows"),
+                                              payload.get("labels"),
+                                              hops=hops)
+                        except Exception as exc:
+                            self._json(503, {"error": "ingest forward "
+                                             "failed: %s" % exc})
+                            return
+                        self._json(200, doc)
+                        return
+                    doc = {"error": "online training is not enabled "
+                           "for model %r" % entry.model_id}
+                    hint = server._leader_hint()
+                    if hint:
+                        # no forwarder here, but tell the client who IS
+                        # the leader so it can re-aim itself
+                        doc["leader_hint"] = hint
+                    self._json(409, doc)
                     return
                 try:
                     rows = np.asarray(payload["rows"], np.float64)
@@ -409,6 +606,9 @@ class PredictServer:
         if self.fleet_transport is not None:
             # remote replica: request/retry/checksum-failure counts
             doc["fleet_transport"] = self.fleet_transport.state()
+        if self.ingest_forwarder is not None:
+            # control plane: relayed-chunk counts + cached leader
+            doc["ingest_forwarder"] = self.ingest_forwarder.state()
         try:
             from .. import obs_device
             # compact device-cost view: HBM watermark + capture totals
@@ -424,6 +624,21 @@ class PredictServer:
         except KeyError:
             pass
         return doc
+
+    def _leader_hint(self) -> Optional[str]:
+        """The current lease holder's advertised serving URL (from the
+        attached local store's lease record), or None — stamped into
+        409 bodies so a rejected writer learns where to go."""
+        store = self.fleet_store
+        if store is None:
+            return None
+        try:
+            lease = store.lease_state()
+        except Exception:
+            return None
+        if lease.get("held") and lease.get("url"):
+            return str(lease["url"])
+        return None
 
     def fleet_status(self) -> dict:
         """The ``GET /fleet/status`` rollup: one document describing the

@@ -14,8 +14,11 @@
 # must shrink monotonically; run scripts/lint.py --update-baseline).
 #   check.sh --fleet    lint + lint tests + the fleet/online/serve fast
 #                       subset (durability/fairness/rollback plus the
-#                       failover/compaction/transport hardening tests
-#                       and the fleet-observatory status/trace tests)
+#                       failover/compaction/transport hardening tests,
+#                       the fleet-observatory status/trace tests and
+#                       the region control-plane suite: remote write
+#                       surface, multi-endpoint failover, ingest
+#                       forwarding, snapshot bootstrap)
 #   check.sh --slo      everything above, plus the closed-loop serving
 #                       SLO bench gated against SLO_BASELINE.json
 #   check.sh --ledger   everything above, plus the run-ledger regression
@@ -57,7 +60,7 @@ if [ "$RUN_FLEET" = 1 ]; then
     echo "== fleet/online/serve fast tests =="
     JAX_PLATFORMS=cpu python -m pytest -q -m 'not slow' \
         tests/test_fleet.py tests/test_failover.py \
-        tests/test_fleet_obs.py \
+        tests/test_fleet_obs.py tests/test_control.py \
         tests/test_online.py tests/test_serve.py
 fi
 

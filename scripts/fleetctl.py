@@ -1,20 +1,25 @@
-"""Fleet status plane CLI: one trainer endpoint, the whole fleet.
+"""Fleet status plane CLI: any number of endpoints, the whole fleet.
 
 Usage:
-    python scripts/fleetctl.py status <trainer-url>   # per-node rollup
-    python scripts/fleetctl.py lag    <trainer-url>   # convergence lag
-    python scripts/fleetctl.py tail   <trainer-url> [-n 10]  # publishes
+    python scripts/fleetctl.py status <url> [--url <url2> ...]
+    python scripts/fleetctl.py lag    <url> [--url <url2> ...]
+    python scripts/fleetctl.py tail   <url> [-n 10]   # publishes
 
 ``status`` renders ``GET /fleet/status``: store head version + lease
 state, then one row per node (trainer, standbys, replicas — local nodes
 heartbeat straight into the store, remote replicas POST theirs to
 ``/fleet/heartbeat``) with role, model version, version skew vs head,
-publish->adopt lag (last/p50/p99 ms) and heartbeat age. ``lag`` is the
+publish->adopt lag (last/p50/p99 ms) and heartbeat age. With MULTIPLE
+``--url`` endpoints (a multi-homed region) the per-endpoint documents
+are merged into ONE table: nodes are deduplicated by node id and the
+newest heartbeat wins, skew is recomputed against the merged head
+version, and an ENDPOINTS line reports who answered. ``lag`` is the
 convergence columns alone; ``tail`` renders the newest publish events
-from ``GET /fleet/publishes``.
+from ``GET /fleet/publishes`` (first reachable endpoint).
 
 Stdlib-only on purpose: a laptop with no jax can point it at any
-trainer. Exit 1 when the endpoint is unreachable or fleet mode is off.
+trainer. Exit 1 when every endpoint is unreachable or fleet mode is
+off.
 """
 import argparse
 import json
@@ -33,6 +38,45 @@ def fetch_json(url, path, timeout_s=5.0):
 
 def fetch_status(url, timeout_s=5.0):
     return fetch_json(url, "/fleet/status", timeout_s)
+
+
+def merge_status(docs):
+    """Merge per-endpoint ``/fleet/status`` documents into one fleet
+    view: nodes deduplicated by node id with the NEWEST heartbeat
+    winning (two endpoints sharing a store both report every node; after
+    a partition heals, one of them may hold a stale copy), head version
+    = max across endpoints, lease/log taken from the endpoint that saw
+    that head (the most caught-up vantage), and every node's skew
+    recomputed against the merged head so the table is self-consistent.
+    """
+    docs = [d for d in docs if isinstance(d, dict)]
+    if not docs:
+        return {"nodes": []}
+    best = max(docs, key=lambda d: int(d.get("head_version", 0) or 0))
+    head = int(best.get("head_version", 0) or 0)
+    nodes = {}
+    for doc in docs:
+        for node in doc.get("nodes", []):
+            if not isinstance(node, dict):
+                continue
+            nid = str(node.get("node", "?"))
+            cur = nodes.get(nid)
+            if cur is None or float(node.get("ts", 0.0) or 0.0) \
+                    > float(cur.get("ts", 0.0) or 0.0):
+                nodes[nid] = node
+    merged = []
+    for nid in sorted(nodes):
+        node = dict(nodes[nid])
+        node["skew"] = max(0, head - int(node.get("version", 0) or 0))
+        merged.append(node)
+    return {
+        "model_id": best.get("model_id", "?"),
+        "head_version": head,
+        "lease": best.get("lease") or {},
+        "log_bytes": best.get("log_bytes", "?"),
+        "compactions": best.get("compactions", "?"),
+        "nodes": merged,
+    }
 
 
 def _ms(v):
@@ -77,24 +121,36 @@ def _render_nodes(doc):
     return [fmt % header] + [fmt % r for r in rows]
 
 
-def render_status(doc):
-    """``/fleet/status`` document -> printable lines."""
+def _endpoints_line(reachable, unreachable):
+    if not unreachable and len(reachable) <= 1:
+        return []
+    parts = ["%s ok" % u for u in reachable]
+    parts += ["%s DOWN" % u for u in unreachable]
+    return ["endpoints: " + "  ".join(parts)]
+
+
+def render_status(doc, reachable=(), unreachable=()):
+    """Merged ``/fleet/status`` document -> printable lines."""
     lease = doc.get("lease") or {}
     lines = [
         "model %s  head v%s  log %s B  compactions %s"
         % (doc.get("model_id", "?"), doc.get("head_version", "?"),
            doc.get("log_bytes", "?"), doc.get("compactions", "?")),
         "lease %s"
-        % ("held by %s (epoch %s)" % (lease.get("holder"),
-                                      lease.get("epoch"))
+        % ("held by %s (epoch %s)%s"
+           % (lease.get("holder"), lease.get("epoch"),
+              " @ %s" % lease["url"] if lease.get("url") else "")
            if lease.get("held") else "free"),
     ]
+    lines += _endpoints_line(list(reachable), list(unreachable))
     return lines + _render_nodes(doc)
 
 
-def render_lag(doc):
+def render_lag(doc, reachable=(), unreachable=()):
     """Convergence-only view: skew + publish->adopt lag per node."""
-    return ["head v%s" % doc.get("head_version", "?")] + _render_nodes(doc)
+    return (["head v%s" % doc.get("head_version", "?")]
+            + _endpoints_line(list(reachable), list(unreachable))
+            + _render_nodes(doc))
 
 
 def render_tail(doc, n=10):
@@ -117,28 +173,57 @@ def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="fleetctl", description=__doc__.splitlines()[0])
     ap.add_argument("command", choices=("status", "lag", "tail"))
-    ap.add_argument("url", help="trainer base url, e.g. http://host:8080")
+    ap.add_argument("url", nargs="?",
+                    help="fleet base url, e.g. http://host:8080")
+    ap.add_argument("--url", dest="urls", action="append", default=[],
+                    metavar="URL",
+                    help="additional fleet endpoint (repeatable; "
+                    "status/lag merge all endpoints into one table)")
     ap.add_argument("-n", type=int, default=10,
                     help="tail: newest N publishes (default 10)")
     ap.add_argument("--timeout", type=float, default=5.0)
     args = ap.parse_args(argv)
-    try:
-        if args.command == "tail":
-            doc = fetch_json(args.url, "/fleet/publishes", args.timeout)
-            lines = render_tail(doc, args.n)
-        else:
-            doc = fetch_status(args.url, args.timeout)
-            lines = (render_status if args.command == "status"
-                     else render_lag)(doc)
-    except urllib.error.HTTPError as exc:
-        print("fleetctl: %s answered %d (fleet store attached?)"
-              % (args.url, exc.code), file=sys.stderr)
-        return 1
-    except (urllib.error.URLError, OSError, ValueError) as exc:
-        print("fleetctl: cannot reach %s: %s" % (args.url, exc),
+    urls = ([args.url] if args.url else []) + list(args.urls)
+    # dedup, order-preserving: `fleetctl status URL --url URL` is one
+    # endpoint, not the same document merged with itself
+    seen = set()
+    urls = [u for u in urls
+            if u.rstrip("/") not in seen
+            and not seen.add(u.rstrip("/"))]
+    if not urls:
+        ap.error("need at least one endpoint (positional url or --url)")
+    if args.command == "tail":
+        last_exc = None
+        for url in urls:
+            try:
+                doc = fetch_json(url, "/fleet/publishes", args.timeout)
+            except (urllib.error.URLError, OSError, ValueError) as exc:
+                last_exc = (url, exc)
+                continue
+            for line in render_tail(doc, args.n):
+                print(line)
+            return 0
+        print("fleetctl: cannot reach %s: %s" % last_exc,
               file=sys.stderr)
         return 1
-    for line in lines:
+    docs, reachable, unreachable = [], [], []
+    for url in urls:
+        try:
+            docs.append(fetch_status(url, args.timeout))
+            reachable.append(url)
+        except urllib.error.HTTPError as exc:
+            print("fleetctl: %s answered %d (fleet store attached?)"
+                  % (url, exc.code), file=sys.stderr)
+            unreachable.append(url)
+        except (urllib.error.URLError, OSError, ValueError) as exc:
+            print("fleetctl: cannot reach %s: %s" % (url, exc),
+                  file=sys.stderr)
+            unreachable.append(url)
+    if not docs:
+        return 1
+    doc = merge_status(docs)
+    render = render_status if args.command == "status" else render_lag
+    for line in render(doc, reachable, unreachable):
         print(line)
     return 0
 

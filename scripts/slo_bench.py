@@ -24,6 +24,18 @@ holder's late publish raises StaleLeaseError, a post-takeover promotion
 lands, both replicas re-converge, version tokens stay unique, and every
 applied publish is exactly one whole-model version bump.
 
+``--failover`` then runs a SECOND drill (two JSON lines total, both must
+pass): the region/two-endpoint drill. A trainer behind its own HTTP
+endpoint holds the lease; two store-host endpoints expose the same store
+over ``/fleet/*`` and forward labeled ``/ingest`` traffic to the lease
+holder; a serving replica watches the pair through a
+``MultiEndpointStore``. Mid-load the replica's PRIMARY endpoint is
+killed. Gates: the watcher fails over to the survivor and re-converges,
+publish->adopt lag p99 stays under ``--lag-p99-target-ms``, ZERO acked
+ingest rows are dropped on the way through forwarding, every applied
+publish is one version bump, and a cold standby boots over HTTP from
+snapshot + tail (``cold_boot_s`` reported in the JSON).
+
 ``--noisy-tenant`` measures per-tenant fairness: a quota-respecting
 tenant's client-side p99 is taken solo, then again while a flooding
 tenant saturates its own quota; the gate fails when the polite tenant is
@@ -437,6 +449,271 @@ def _run_failover(args) -> int:
     return 0 if result["pass"] else 1
 
 
+def _run_failover_region(args) -> int:
+    """Two-endpoint region drill: a replica follows TWO store-host
+    endpoints through a ``MultiEndpointStore`` while labeled traffic is
+    forwarded over HTTP to the lease holder; the replica's primary
+    endpoint is killed mid-load and the drill gates on failover,
+    publish->adopt lag p99, zero dropped forwarded ingest rows, and an
+    HTTP-only cold boot from snapshot + tail."""
+    import tempfile
+
+    from lightgbm_tpu import obs
+    from lightgbm_tpu.fleet import FleetStore, IngestForwarder, \
+        MultiEndpointStore, RemoteWriteStore, ReplicaWatcher, \
+        bootstrap_model
+    from lightgbm_tpu.obs import telemetry
+    from lightgbm_tpu.online import OnlineTrainer
+    from lightgbm_tpu.serve import PredictServer
+
+    preset = _preset(args)
+    clients = args.clients or preset["clients"]
+    total = args.requests or preset["requests"]
+    rows = args.rows_per_request
+    grace = 30 if args.quick else 60
+    bst, rng, w = _train_seed(preset)
+    telemetry.reset()
+
+    tmp = tempfile.mkdtemp(prefix="lgbtpu_region_bench_")
+    store_t = FleetStore(tmp, "default")
+    store_t.publish(bst.model_to_string(), event="boot")
+
+    # the leader: an online trainer behind its OWN endpoint — forwarded
+    # ingest lands here; snapshot compaction keeps the log cold-bootable
+    trainer = OnlineTrainer(bst, trigger_rows=max(256, rows * 8),
+                            min_rows=128, shadow_rows=1024,
+                            store=store_t, holder_id="trainer",
+                            lease_ttl_s=5.0,
+                            compact_bytes=400_000, snapshot_rows=2048)
+    server_t = PredictServer(bst, port=0, buckets=(64, 256), warmup=True,
+                             max_wait_ms=2.0, online=trainer)
+    server_t.fleet_store = store_t
+    th_t = threading.Thread(target=server_t.serve_forever,
+                            name="slo-region-leader", daemon=True)
+    th_t.start()
+    host, port = server_t.address
+    # advertised in the lease doc on the next renew tick — forwarders
+    # resolve the leader from there
+    trainer.advertise_url = "http://%s:%d" % (host, port)
+
+    gate_msgs = []
+    if not trainer.wait_for_lease(grace):
+        gate_msgs.append("trainer never went active")
+
+    # two store-host endpoints over the same store dir: the replica's
+    # fleet_urls pair, each also forwarding labeled /ingest to the leader
+    eps = []
+    for i in range(2):
+        st = FleetStore(tmp, "default")
+        eb, _ = bootstrap_model(st)
+        srv = PredictServer(eb, port=0, buckets=(64, 256), warmup=True,
+                            max_wait_ms=2.0)
+        srv.fleet_store = st
+        srv.ingest_forwarder = IngestForwarder(store=st, timeout_s=10.0)
+        thr = threading.Thread(target=srv.serve_forever,
+                               name="slo-region-ep%d" % i, daemon=True)
+        thr.start()
+        h, p = srv.address
+        eps.append({"server": srv, "thread": thr, "store": st,
+                    "base": "http://%s:%d" % (h, p), "alive": True})
+
+    # the serving replica under client load: follows BOTH endpoints
+    mstore = MultiEndpointStore([e["base"] for e in eps], timeout_s=10.0,
+                                cooldown_base_s=0.1, cooldown_max_s=1.0)
+    rb, applied = bootstrap_model(mstore)
+    rserver = PredictServer(rb, port=0, buckets=(64, 256), warmup=True,
+                            max_wait_ms=2.0)
+    rserver.fleet_watcher = ReplicaWatcher(rb, mstore, poll_interval_s=0.1,
+                                           applied_version=applied)
+    rth = threading.Thread(target=rserver.serve_forever,
+                           name="slo-region-replica", daemon=True)
+    rth.start()
+    rh, rp = rserver.address
+    rbase = "http://%s:%d" % (rh, rp)
+    v0 = rb.inner.model_version
+
+    # labeled traffic hits the store-host endpoints (which have NO
+    # trainer) and must arrive at the leader via forwarding; a chunk is
+    # acked only on a 2xx, and acked rows must NEVER be dropped
+    acked = {"rows": 0}
+    stop_ingest = threading.Event()
+
+    def ingest_loop():
+        from urllib.request import Request, urlopen
+        k = 0
+        while not stop_ingest.is_set():
+            Xi = rng.randn(64, preset["features"])
+            yi = (Xi @ w > 0).astype("float64")
+            body = json.dumps({"rows": Xi.tolist(),
+                               "labels": yi.tolist()}).encode()
+            for attempt in range(8):
+                base = eps[(k + attempt) % 2]["base"]
+                req = Request(base + "/ingest", data=body,
+                              headers={"Content-Type": "application/json"})
+                try:
+                    with urlopen(req, timeout=30) as resp:
+                        resp.read()
+                    acked["rows"] += len(Xi)
+                    break
+                except Exception:  # noqa: BLE001 - retry on the peer
+                    time.sleep(0.05)
+            k += 1
+            time.sleep(0.02)
+
+    ingester = threading.Thread(target=ingest_loop,
+                                name="slo-region-ingest", daemon=True)
+    ingester.start()
+
+    fails, sheds = [], []
+    threads = [threading.Thread(
+        target=_client, name="slo-region-c%d" % i,
+        args=(rbase, total // clients, rows,
+              json.dumps({"rows": rng.randn(
+                  rows, preset["features"]).tolist()}).encode(),
+              fails, sheds))
+        for i in range(clients)]
+    for t in threads:
+        t.start()
+
+    # phase 1: at least one promotion must land AND be adopted through
+    # the current primary before we kill it
+    deadline = obs.monotonic() + grace
+    while obs.monotonic() < deadline:
+        if trainer.state()["promotions"] >= 1 \
+                and rserver.fleet_watcher.state()["swaps"] >= 1:
+            break
+        time.sleep(0.1)
+    promos_pre = trainer.state()["promotions"]
+    if promos_pre < 1:
+        gate_msgs.append("no promotion landed before the endpoint kill")
+
+    # phase 2: kill the watcher's PRIMARY endpoint mid-load
+    primary = mstore.base_url
+    victim = next(e for e in eps if e["base"] == primary)
+    victim["server"].shutdown()
+    victim["thread"].join(timeout=30)
+    victim["server"].close()
+    victim["alive"] = False
+    survivor = next(e for e in eps if e["alive"])
+
+    # phase 3: the pipeline must keep moving through the survivor — a
+    # post-kill promotion lands and the replica converges on it
+    converged = False
+    deadline = obs.monotonic() + grace
+    while obs.monotonic() < deadline:
+        published = store_t.state()["last_published_version"]
+        if trainer.state()["promotions"] > promos_pre \
+                and rserver.fleet_watcher.applied_version == published:
+            converged = True
+            break
+        time.sleep(0.1)
+    if trainer.state()["promotions"] <= promos_pre:
+        gate_msgs.append("no post-kill promotion landed")
+
+    for t in threads:
+        t.join()
+    stop_ingest.set()
+    ingester.join(timeout=30)
+
+    # drain: every acked forwarded chunk is synchronously ingested by
+    # the leader before its 2xx, so the counters must already agree
+    published = store_t.state()["last_published_version"]
+    if not converged:
+        gate_msgs.append("replica did not converge to v%d through the "
+                         "surviving endpoint" % published)
+    switches = telemetry.counter("fleet/endpoint_switches")
+    if converged and switches < 1:
+        gate_msgs.append("watcher never switched endpoints")
+
+    tstate = trainer.state()
+    dropped = max(0, acked["rows"] - tstate["total_ingested_rows"])
+    if dropped:
+        gate_msgs.append("%d acked ingest rows never reached the "
+                         "leader" % dropped)
+    lag = telemetry.histogram("fleet/publish_adopt_lag_ms") or {}
+    lag_p99 = lag.get("p99")
+    if lag_p99 is None:
+        gate_msgs.append("no publish->adopt lag samples recorded")
+    elif lag_p99 > args.lag_p99_target_ms:
+        gate_msgs.append("publish->adopt lag p99 %.1fms > target %.1fms"
+                         % (lag_p99, args.lag_p99_target_ms))
+
+    wstate = rserver.fleet_watcher.state()
+    bumps = rb.inner.model_version - v0
+    if bumps != wstate["swaps"]:
+        gate_msgs.append("version bumps (%d) != applied swaps (%d)"
+                         % (bumps, wstate["swaps"]))
+    if fails:
+        gate_msgs.append("%d request failures" % len(fails))
+
+    trainer.close(timeout=30)
+    snapshotted = any(e.get("kind") == "compact"
+                      and isinstance(e.get("snapshot"), dict)
+                      for e in store_t.events())
+    if not snapshotted:
+        gate_msgs.append("no snapshot compaction landed (log never "
+                         "crossed compact_bytes?)")
+
+    # phase 4: HTTP-only cold boot off the survivor — a fresh standby on
+    # a "new machine" bootstraps from snapshot + tail, never the disk
+    cold_boot_s = None
+    cold_replayed = 0
+    try:
+        t0 = obs.monotonic()
+        cold_store = RemoteWriteStore(survivor["base"], timeout_s=10.0)
+        cold_bst, _ = bootstrap_model(cold_store)
+        cold = OnlineTrainer(cold_bst, trigger_rows=10 ** 9, min_rows=128,
+                             shadow_rows=1024, store=cold_store,
+                             holder_id="cold-standby")
+        cold_boot_s = obs.monotonic() - t0
+        cold_replayed = cold.state()["replayed_rows"]
+        cold.close(timeout=30)
+    except Exception as exc:  # noqa: BLE001 - gate below
+        gate_msgs.append("cold boot from snapshot+tail failed: %r" % exc)
+
+    rserver.shutdown()
+    rth.join(timeout=30)
+    rserver.close()
+    for e in eps:
+        if e["alive"]:
+            e["server"].shutdown()
+            e["thread"].join(timeout=30)
+            e["server"].close()
+    server_t.shutdown()
+    th_t.join(timeout=30)
+    server_t.close()
+
+    result = {
+        "bench": "slo_failover_region",
+        "quick": bool(args.quick),
+        "killed_endpoint": primary,
+        "endpoint_switches": switches,
+        "published_version": published,
+        "promotions_before_kill": promos_pre,
+        "promotions_total": tstate["promotions"],
+        "replica": {"applied_version": wstate["applied_version"],
+                    "swaps": wstate["swaps"], "version_bumps": bumps},
+        "publish_adopt_lag_ms": {k: lag.get(k)
+                                 for k in ("p50", "p99")},
+        "lag_p99_target_ms": args.lag_p99_target_ms,
+        "ingest_rows_acked": acked["rows"],
+        "ingest_rows_ingested": tstate["total_ingested_rows"],
+        "ingest_rows_dropped": dropped,
+        "forwarded_chunks": telemetry.counter("fleet/forwarded_chunks"),
+        "snapshot_compactions": store_t.state()["compactions"],
+        "cold_boot_s": None if cold_boot_s is None
+        else round(cold_boot_s, 3),
+        "cold_boot_replayed_rows": cold_replayed,
+        "store_dir": tmp,
+        "errors": fails[:5],
+        "pass": not gate_msgs,
+    }
+    if gate_msgs:
+        result["gate_failures"] = gate_msgs
+    print(json.dumps(result))
+    return 0 if result["pass"] else 1
+
+
 def _run_noisy_tenant(args) -> int:
     """Fairness demo/gate: a flooding tenant saturates its quota while a
     quota-respecting tenant keeps its solo latency profile."""
@@ -641,6 +918,9 @@ def main(argv=None) -> int:
                          "releasing its lease; the standby must take "
                          "over, stay fenced against zombie publishes, "
                          "and re-converge both replicas")
+    ap.add_argument("--lag-p99-target-ms", type=float, default=5000.0,
+                    help="--failover region drill gate: publish->adopt "
+                         "lag p99 bound (ms)")
     ap.add_argument("--noisy-tenant", action="store_true",
                     help="per-tenant fairness gate: flooding tenant vs "
                          "quota-respecting tenant")
@@ -676,7 +956,10 @@ def main(argv=None) -> int:
     if args.fleet:
         return _run_fleet(args)
     if args.failover:
-        return _run_failover(args)
+        # two drills, two JSON lines: the lease-crash drill, then the
+        # two-endpoint region drill — both must pass
+        rc = _run_failover(args)
+        return _run_failover_region(args) or rc
     if args.noisy_tenant:
         return _run_noisy_tenant(args)
     if args.ab_dispatch:

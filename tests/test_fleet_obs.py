@@ -98,6 +98,7 @@ def _fleetctl():
 
 # ----------------------------------------------------- federated status plane
 
+@pytest.mark.slow
 def test_fleetctl_status_federates_trainer_and_replicas(tmp_path, capsys):
     """Acceptance e2e: trainer + 2 replicas (one over RemoteStore), one
     ``fleetctl status`` call reports per-node role, model version,
@@ -251,6 +252,7 @@ def _process_meta(doc):
     return names[0]
 
 
+@pytest.mark.slow
 def test_remote_adoption_is_one_trace_across_two_processes(tmp_path):
     """Acceptance: a Chrome/Perfetto export from a remote-replica
     adoption contains trainer-side and replica-side spans sharing one
@@ -387,6 +389,7 @@ def test_healthz_surfaces_replica_adoption_state(tmp_path):
         server.close()
 
 
+@pytest.mark.slow
 def test_watcher_convergence_metrics(tmp_path):
     """The lag histogram and skew gauge feed off real publish
     timestamps; consecutive-error tracking resets on success."""
